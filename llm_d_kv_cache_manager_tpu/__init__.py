@@ -1,0 +1,25 @@
+"""llm-d-kv-cache-manager-tpu: TPU-native KV-cache-aware routing framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``llm-d-kv-cache-manager`` (reference: Go library + service providing
+KV-cache-aware routing for a vLLM fleet; see /root/reference).
+
+Components:
+
+- ``kvcache``        — block index, token→block hashing, scorer, orchestrator
+                       (parity with reference ``pkg/kvcache``).
+- ``kvcache.kvevents`` — msgpack/ZMQ KV-event ingestion plane
+                       (parity with reference ``pkg/kvcache/kvevents``).
+- ``tokenization``   — tokenizer pool + text-prefix→token store
+                       (parity with reference ``pkg/tokenization``).
+- ``preprocessing``  — chat-completions templating
+                       (parity with reference ``pkg/preprocessing``).
+- ``server``         — the in-tree JAX paged-KV inference server (new; the
+                       reference drives external vLLM pods instead).
+- ``models``         — JAX model definitions (Llama-class decoders).
+- ``ops``            — TPU compute kernels (Pallas paged attention, etc.).
+- ``parallel``       — device-mesh / sharding helpers (tp/dp over ICI/DCN).
+- ``native``         — C++ hot-path kernels (CBOR/SHA-256 block hashing).
+"""
+
+__version__ = "0.1.0"

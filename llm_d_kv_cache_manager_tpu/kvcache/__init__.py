@@ -1,0 +1,1 @@
+from . import kvblock  # noqa: F401

@@ -1,0 +1,8 @@
+"""Native (C++) hot-path kernels with pure-Python fallbacks.
+
+The reference ships native code for its hot paths (Rust HF tokenizers,
+embedded-CPython bridge, libzmq; see reference ``Makefile:28-44``,
+``pkg/preprocessing/chat_completions/cgo_functions.c``). Here the
+parity-critical native kernel is the CBOR/SHA-256 chained block hasher
+(``hashcore.cpp``), exposed through ctypes in ``hashcore.py``.
+"""

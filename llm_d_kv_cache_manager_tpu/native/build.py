@@ -1,0 +1,33 @@
+"""Build the native hash kernel: ``python -m llm_d_kv_cache_manager_tpu.native.build``."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build(verbose: bool = True) -> str:
+    src = os.path.join(HERE, "hashcore.cpp")
+    out = os.path.join(HERE, "libhashcore.so")
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        src,
+        "-o",
+        out,
+    ]
+    if verbose:
+        print("+", " ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print(path)

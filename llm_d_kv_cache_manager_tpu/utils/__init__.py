@@ -1,0 +1,3 @@
+from .logging import get_logger, DEBUG, TRACE
+
+__all__ = ["get_logger", "DEBUG", "TRACE"]

@@ -1,0 +1,74 @@
+"""Structured logging with verbosity levels.
+
+Mirrors the reference's klog verbosity convention (reference
+``pkg/utils/logging/levels.go:17-20``): DEBUG=4, TRACE=5. We map these onto
+stdlib logging levels below ``logging.DEBUG`` so that `-v=5`-style tracing can
+be enabled independently of ordinary debug output.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+# klog-style verbosity levels, mapped into stdlib numeric levels.
+# stdlib DEBUG is 10; we give TRACE a lower number so it is *more* verbose.
+DEBUG = logging.DEBUG  # klog V(4)
+TRACE = 5  # klog V(5)
+
+logging.addLevelName(TRACE, "TRACE")
+
+_PKG_LOGGER = "llm_d_kv_cache_manager_tpu"
+_CONFIGURED = False
+
+
+def _configure_package_logger() -> None:
+    """Configure only this package's logger subtree — never the root logger,
+    so embedding applications keep control of their own logging setup.
+
+    Entry points (the online service, demos) may call
+    ``logging.basicConfig`` themselves; library imports must not.
+    """
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    pkg = logging.getLogger(_PKG_LOGGER)
+    level_name = os.environ.get("KVCACHE_LOG_LEVEL", "").upper()
+    if level_name:
+        level = TRACE if level_name == "TRACE" else getattr(logging, level_name, logging.INFO)
+        pkg.setLevel(level)
+        if not pkg.handlers:
+            handler = logging.StreamHandler()
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+            )
+            pkg.addHandler(handler)
+    else:
+        pkg.addHandler(logging.NullHandler())
+    _CONFIGURED = True
+
+
+class _KVLogger(logging.LoggerAdapter):
+    """Logger adapter supporting structured key-values, klog style.
+
+    ``log.debug("msg", keys=..., pods=...)`` renders the kwargs as
+    ``msg | keys=... pods=...``.
+    """
+
+    _RESERVED = ("exc_info", "stack_info", "stacklevel", "extra")
+
+    def process(self, msg, kwargs):
+        kv = {k: kwargs.pop(k) for k in list(kwargs) if k not in self._RESERVED}
+        if kv:
+            msg = f"{msg} | " + " ".join(f"{k}={v!r}" for k, v in kv.items())
+        return msg, kwargs
+
+    def trace(self, msg, *args, **kwargs):
+        self.log(TRACE, msg, *args, **kwargs)
+
+
+def get_logger(name: str) -> _KVLogger:
+    _configure_package_logger()
+    if not name.startswith(_PKG_LOGGER):
+        name = f"{_PKG_LOGGER}.{name}"
+    return _KVLogger(logging.getLogger(name), {})

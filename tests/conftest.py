@@ -1,0 +1,19 @@
+"""Test bootstrap.
+
+Tests run on CPU with a virtual 8-device mesh so multi-chip sharding code is
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path; bench.py runs on the one real chip).
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

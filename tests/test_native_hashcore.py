@@ -1,0 +1,62 @@
+"""Native C++ hash kernel ↔ pure-Python parity.
+
+The pure-Python implementation in ``token_processor.py`` is the audited
+oracle (byte-level CBOR goldens in test_token_processor.py); the native
+kernel must match it exactly on every input shape.
+"""
+
+import random
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.native import build as native_build
+from llm_d_kv_cache_manager_tpu.native import hashcore
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import token_processor as tp
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    try:
+        native_build.build(verbose=False)
+    except Exception as e:  # no compiler on this machine → pure-Python still gates
+        pytest.skip(f"native build unavailable: {e}")
+    # reset the module's load cache so a fresh .so is picked up
+    hashcore._lib = None
+    hashcore._load_attempted = False
+    assert hashcore.available()
+
+
+def _py_chain(parent, tokens, block_size):
+    out, prefix = [], parent
+    n = (len(tokens) // block_size) * block_size
+    for i in range(0, n, block_size):
+        prefix = tp.hash_block(prefix, tokens[i : i + block_size])
+        out.append(prefix)
+    return out
+
+
+class TestNativeParity:
+    @pytest.mark.parametrize("seed", ["", "42", "sémillon", "a" * 300])
+    def test_root_hash(self, seed):
+        assert hashcore.root_hash(seed) == tp.root_hash(seed)
+
+    @pytest.mark.parametrize("n,bs", [(0, 16), (15, 16), (16, 16), (17, 16), (160, 16), (48, 4), (1000, 16), (256, 256)])
+    def test_chain(self, n, bs):
+        rng = random.Random(n * 31 + bs)
+        tokens = [rng.randrange(0, 2**32) for _ in range(n)]
+        root = tp.root_hash("")
+        assert hashcore.chain_hashes(root, tokens, bs) == _py_chain(root, tokens, bs)
+
+    def test_token_processor_uses_native(self):
+        db = tp.ChunkedTokenDatabase(tp.TokenProcessorConfig(use_native=True))
+        dbp = tp.ChunkedTokenDatabase(tp.TokenProcessorConfig(use_native=False))
+        assert db._native is not None
+        assert dbp._native is None
+        toks = list(range(777))
+        assert db.prefix_hashes(toks) == dbp.prefix_hashes(toks)
+
+    def test_boundary_token_values(self):
+        root = tp.root_hash("")
+        for v in (0, 23, 24, 255, 256, 65535, 65536, 2**32 - 1):
+            toks = [v] * 16
+            assert hashcore.chain_hashes(root, toks, 16) == _py_chain(root, toks, 16)

@@ -1,0 +1,133 @@
+"""CBOR canonical encoding + chained block hashing parity tests.
+
+Golden bytes are hand-derived from RFC 8949 so the encoder is checked
+independently of its own implementation. The chain semantics mirror reference
+``pkg/kvcache/kvblock/token_processor.go`` (block size 16, no partial blocks,
+low-8-bytes-big-endian sha256 over CBOR [parent, chunk, None]).
+"""
+
+import hashlib
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.cbor import dumps_canonical
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+    Key,
+    hash_block,
+    root_hash,
+)
+
+
+class TestCanonicalCBOR:
+    @pytest.mark.parametrize(
+        "obj,expected",
+        [
+            (0, b"\x00"),
+            (23, b"\x17"),
+            (24, b"\x18\x18"),
+            (255, b"\x18\xff"),
+            (256, b"\x19\x01\x00"),
+            (65535, b"\x19\xff\xff"),
+            (65536, b"\x1a\x00\x01\x00\x00"),
+            (4294967295, b"\x1a\xff\xff\xff\xff"),
+            (4294967296, b"\x1b\x00\x00\x00\x01\x00\x00\x00\x00"),
+            (2**64 - 1, b"\x1b" + b"\xff" * 8),
+            (-1, b"\x20"),
+            (-25, b"\x38\x18"),
+            (None, b"\xf6"),
+            (True, b"\xf5"),
+            (False, b"\xf4"),
+            ("", b"\x60"),
+            ("a", b"\x61a"),
+            ("hello", b"\x65hello"),
+            # 2-byte UTF-8
+            ("ü", b"\x62\xc3\xbc"),
+            (b"\x01\x02", b"\x42\x01\x02"),
+            ([], b"\x80"),
+            ([1, 2, 3], b"\x83\x01\x02\x03"),
+            ([1, [2, 3]], b"\x82\x01\x82\x02\x03"),
+            ([1, "x", None], b"\x83\x01\x61x\xf6"),
+        ],
+    )
+    def test_golden_bytes(self, obj, expected):
+        assert dumps_canonical(obj) == expected
+
+    def test_uint64_overflow_rejected(self):
+        with pytest.raises(OverflowError):
+            dumps_canonical(2**64)
+
+    def test_canonical_map_key_order(self):
+        # Keys sorted by encoded bytes: int 1 (0x01) < text "a" (0x61 0x61).
+        assert dumps_canonical({"a": 2, 1: 1}) == b"\xa2\x01\x01\x61a\x02"
+
+    def test_numpy_ints_match_python_ints(self):
+        np = pytest.importorskip("numpy")
+        assert dumps_canonical([np.uint32(7), np.int64(300)]) == dumps_canonical([7, 300])
+
+
+def _manual_hash(payload_bytes: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(payload_bytes).digest()[24:32], "big")
+
+
+class TestHashChain:
+    def test_root_hash_empty_seed(self):
+        # CBOR of "" is 0x60; root = low 8 bytes (BE) of sha256(0x60).
+        assert root_hash("") == _manual_hash(b"\x60")
+
+    def test_root_hash_seed_string(self):
+        assert root_hash("42") == _manual_hash(b"\x62\x34\x32")
+
+    def test_single_block_hash_manual(self):
+        # parent=0, tokens [1..16], extra None:
+        # 0x83 array(3) | 0x00 | 0x90 array(16) | 0x01..0x10 | 0xf6
+        payload = b"\x83\x00\x90" + bytes(range(1, 17)) + b"\xf6"
+        assert hash_block(0, list(range(1, 17))) == _manual_hash(payload)
+
+    def test_chain_links(self):
+        db = ChunkedTokenDatabase()
+        tokens = list(range(100, 148))  # 3 full blocks of 16
+        hashes = db.prefix_hashes(tokens)
+        assert len(hashes) == 3
+        parent = db.init_hash
+        for i, chunk_start in enumerate(range(0, 48, 16)):
+            chunk = tokens[chunk_start : chunk_start + 16]
+            parent = hash_block(parent, chunk)
+            assert hashes[i] == parent
+
+    def test_no_partial_blocks(self):
+        db = ChunkedTokenDatabase()
+        assert db.prefix_hashes(list(range(15))) == []
+        assert len(db.prefix_hashes(list(range(17)))) == 1
+        assert len(db.prefix_hashes(list(range(32)))) == 2
+
+    def test_block_size_config(self):
+        db4 = ChunkedTokenDatabase(TokenProcessorConfig(block_size=4))
+        assert len(db4.prefix_hashes(list(range(10)))) == 2
+
+    def test_seed_changes_all_hashes(self):
+        a = ChunkedTokenDatabase(TokenProcessorConfig(hash_seed=""))
+        b = ChunkedTokenDatabase(TokenProcessorConfig(hash_seed="other"))
+        toks = list(range(16))
+        assert a.prefix_hashes(toks) != b.prefix_hashes(toks)
+
+    def test_keys_carry_model_name(self):
+        db = ChunkedTokenDatabase()
+        keys = db.tokens_to_kv_block_keys(list(range(32)), "meta-llama/Llama-3-8B")
+        assert all(isinstance(k, Key) for k in keys)
+        assert all(k.model_name == "meta-llama/Llama-3-8B" for k in keys)
+        assert keys[0].chunk_hash == db.prefix_hashes(list(range(32)))[0]
+
+    def test_prefix_property(self):
+        # Two prompts sharing the first 32 tokens share the first 2 keys.
+        db = ChunkedTokenDatabase()
+        a = db.prefix_hashes(list(range(48)))
+        b = db.prefix_hashes(list(range(32)) + [999] * 16)
+        assert a[:2] == b[:2]
+        assert a[2] != b[2]
+
+    def test_hashes_fit_uint64(self):
+        db = ChunkedTokenDatabase()
+        for h in db.prefix_hashes(list(range(160))):
+            assert 0 <= h < 2**64
