@@ -1,1 +1,20 @@
 from . import kvblock  # noqa: F401
+from .indexer import KVCacheIndexer, KVCacheIndexerConfig
+from .scorer import (
+    KVBlockScorer,
+    KVBlockScorerConfig,
+    LongestPrefixScorer,
+    ScoringStrategy,
+    new_scorer,
+)
+
+__all__ = [
+    "kvblock",
+    "KVCacheIndexer",
+    "KVCacheIndexerConfig",
+    "KVBlockScorer",
+    "KVBlockScorerConfig",
+    "LongestPrefixScorer",
+    "ScoringStrategy",
+    "new_scorer",
+]

@@ -1,0 +1,112 @@
+"""KV-cache Indexer: the read-path orchestrator.
+
+Parity with reference ``pkg/kvcache/indexer.go``: wires the tokenization
+pool (with prefix store), the token→block-key processor, the block index,
+and the scorer; ``get_pod_scores`` is the hot RPC
+(``indexer.go:117-151``):
+
+    prompt → tokenize (prefix-store fast path) → chunk+hash → index lookup
+           → longest-prefix score → {pod: score}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..tokenization import TokenizationPool, TokenizationPoolConfig
+from ..tokenization.prefixstore import Indexer as PrefixStoreIndexer
+from ..tokenization.tokenizer import Tokenizer
+from ..utils import get_logger
+from .kvblock import (
+    ChunkedTokenDatabase,
+    Index,
+    IndexConfig,
+    TokenProcessorConfig,
+    create_index,
+)
+from .scorer import KVBlockScorer, KVBlockScorerConfig, new_scorer
+
+log = get_logger("kvcache.indexer")
+
+
+@dataclass
+class KVCacheIndexerConfig:
+    """Composed config, one member per component
+    (reference ``indexer.go:35-52``)."""
+
+    token_processor: TokenProcessorConfig = field(default_factory=TokenProcessorConfig)
+    index: IndexConfig = field(default_factory=IndexConfig)
+    scorer: KVBlockScorerConfig = field(default_factory=KVBlockScorerConfig)
+    tokenization_pool: TokenizationPoolConfig = field(default_factory=TokenizationPoolConfig)
+
+
+class KVCacheIndexer:
+    """Orchestrates scoring requests for KV-cache-aware routing."""
+
+    def __init__(
+        self,
+        config: Optional[KVCacheIndexerConfig] = None,
+        *,
+        index: Optional[Index] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        prefix_store: Optional[PrefixStoreIndexer] = None,
+    ):
+        self.config = config or KVCacheIndexerConfig()
+        self.token_processor = ChunkedTokenDatabase(self.config.token_processor)
+        self.kv_block_index: Index = (
+            index if index is not None else create_index(self.config.index)
+        )
+        self.scorer: KVBlockScorer = new_scorer(self.config.scorer)
+        self.tokenization_pool = TokenizationPool(
+            self.config.tokenization_pool, store=prefix_store, tokenizer=tokenizer
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    def run(self) -> None:
+        """Start background workers (reference ``Indexer.Run``)."""
+        self.tokenization_pool.run()
+
+    def shutdown(self) -> None:
+        self.tokenization_pool.shutdown()
+
+    # -- the hot RPC --------------------------------------------------------
+    def get_pod_scores(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+    ) -> dict[str, int]:
+        """Score candidate pods by longest consecutive cached-prefix match
+        for ``prompt``. Empty/None ``pod_identifiers`` scores all known pods.
+        """
+        tokens = self.tokenization_pool.tokenize(prompt, model_name)
+        log.debug("tokenized prompt", n_tokens=len(tokens), model=model_name)
+
+        block_keys = self.token_processor.tokens_to_kv_block_keys(tokens, model_name)
+        log.debug("computed block keys", n_keys=len(block_keys))
+        if not block_keys:
+            return {}
+
+        pod_filter = set(pod_identifiers) if pod_identifiers else set()
+        key_to_pods = self.kv_block_index.lookup(block_keys, pod_filter)
+        log.debug("index lookup", n_hits=len(key_to_pods))
+
+        scores = self.scorer.score(block_keys, key_to_pods)
+        log.debug("scored pods", scores=scores)
+        return scores
+
+    def score_tokens(
+        self,
+        tokens: Sequence[int],
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+    ) -> dict[str, int]:
+        """Scoring entry for callers that already hold token ids (the in-tree
+        JAX server's router path — skips the tokenizer pool hop)."""
+        block_keys = self.token_processor.tokens_to_kv_block_keys(tokens, model_name)
+        if not block_keys:
+            return {}
+        pod_filter = set(pod_identifiers) if pod_identifiers else set()
+        key_to_pods = self.kv_block_index.lookup(block_keys, pod_filter)
+        return self.scorer.score(block_keys, key_to_pods)

@@ -1,0 +1,52 @@
+"""Metrics decorator around any Index backend.
+
+Parity with reference ``pkg/kvcache/kvblock/instrumented_index.go``: wraps an
+``Index`` and emits admissions / evictions / lookup-request / lookup-latency
+metrics around each call. Also increments the per-key hit counter the
+reference defined but never wired (SURVEY §5 gap).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from ..metrics import collector
+from .index import Index
+from .keys import Key, PodEntry
+
+
+class InstrumentedIndex(Index):
+    def __init__(self, inner: Index):
+        self._inner = inner
+
+    @property
+    def inner(self) -> Index:
+        return self._inner
+
+    def lookup(
+        self, keys: Sequence[Key], pod_filter: Optional[set[str]] = None
+    ) -> dict[Key, list[str]]:
+        collector.lookup_requests.inc()
+        collector.bump("lookup_requests")
+        start = time.perf_counter()
+        try:
+            result = self._inner.lookup(keys, pod_filter)
+        finally:
+            collector.lookup_latency.observe(time.perf_counter() - start)
+        hits = sum(1 for pods in result.values() if pods)
+        if hits:
+            collector.lookup_hits.inc(hits)
+            collector.bump("lookup_hits", hits)
+        return result
+
+    def add(self, keys: Sequence[Key], entries: Sequence[PodEntry]) -> None:
+        self._inner.add(keys, entries)
+        n = len(keys) * len(entries)
+        collector.admissions.inc(n)
+        collector.bump("admissions", n)
+
+    def evict(self, key: Key, entries: Sequence[PodEntry]) -> None:
+        self._inner.evict(key, entries)
+        collector.evictions.inc(len(entries))
+        collector.bump("evictions", len(entries))

@@ -1,0 +1,1 @@
+from . import collector  # noqa: F401

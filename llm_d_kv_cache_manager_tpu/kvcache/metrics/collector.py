@@ -1,0 +1,134 @@
+"""Prometheus metrics for the KV-block index.
+
+Metric names match the reference collectors
+(``pkg/kvcache/metrics/collector.go:29-54``):
+
+- ``kvcache_index_admissions_total``
+- ``kvcache_index_evictions_total``
+- ``kvcache_index_lookup_requests_total``
+- ``kvcache_index_lookup_hits_total``  (defined-but-never-incremented in the
+  reference — a noted gap; here it counts per-key hits returned by lookups)
+- ``kvcache_index_lookup_latency_seconds`` histogram
+
+A periodic "metrics beat" log thread mirrors ``StartMetricsLogging``
+(``collector.go:75-130``). Falls back to inert counters when
+``prometheus_client`` is unavailable so the library never hard-depends on it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ...utils import get_logger
+
+log = get_logger("kvcache.metrics")
+
+try:
+    import prometheus_client as _prom
+except ImportError:  # pragma: no cover
+    _prom = None
+
+
+class _NullMetric:
+    def inc(self, *_a, **_k):
+        pass
+
+    def observe(self, *_a, **_k):
+        pass
+
+    def labels(self, *_a, **_k):
+        return self
+
+
+_registered = False
+_lock = threading.Lock()
+
+admissions = _NullMetric()
+evictions = _NullMetric()
+lookup_requests = _NullMetric()
+lookup_hits = _NullMetric()
+lookup_latency = _NullMetric()
+
+# Internal shadow counters so the metrics beat can log without scraping.
+_shadow = {
+    "admissions": 0,
+    "evictions": 0,
+    "lookup_requests": 0,
+    "lookup_hits": 0,
+}
+_shadow_lock = threading.Lock()
+
+
+def bump(name: str, amount: int = 1) -> None:
+    with _shadow_lock:
+        _shadow[name] += amount
+
+
+def snapshot() -> dict:
+    with _shadow_lock:
+        return dict(_shadow)
+
+
+def register(registry=None) -> None:
+    """Idempotently create and register the collectors."""
+    global _registered, admissions, evictions, lookup_requests, lookup_hits, lookup_latency
+    with _lock:
+        if _registered:
+            return
+        if _prom is None:
+            _registered = True
+            return
+        registry = registry or _prom.REGISTRY
+        admissions = _prom.Counter(
+            "kvcache_index_admissions_total",
+            "Total number of KV-block admissions into the index",
+            registry=registry,
+        )
+        evictions = _prom.Counter(
+            "kvcache_index_evictions_total",
+            "Total number of KV-block evictions from the index",
+            registry=registry,
+        )
+        lookup_requests = _prom.Counter(
+            "kvcache_index_lookup_requests_total",
+            "Total number of index lookup requests",
+            registry=registry,
+        )
+        lookup_hits = _prom.Counter(
+            "kvcache_index_lookup_hits_total",
+            "Total number of per-key hits returned by index lookups",
+            registry=registry,
+        )
+        lookup_latency = _prom.Histogram(
+            "kvcache_index_lookup_latency_seconds",
+            "Latency of index lookups in seconds",
+            registry=registry,
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+        )
+        _registered = True
+
+
+_beat_thread: Optional[threading.Thread] = None
+_beat_stop = threading.Event()
+
+
+def start_metrics_logging(interval_seconds: float) -> None:
+    """Spawn the non-blocking metrics-beat logger (idempotent)."""
+    global _beat_thread
+    with _lock:
+        if _beat_thread is not None and _beat_thread.is_alive():
+            return
+        _beat_stop.clear()
+
+        def beat():
+            while not _beat_stop.wait(interval_seconds):
+                log.info("metrics beat", **snapshot())
+
+        _beat_thread = threading.Thread(target=beat, name="kvcache-metrics-beat", daemon=True)
+        _beat_thread.start()
+
+
+def stop_metrics_logging() -> None:
+    _beat_stop.set()

@@ -1,0 +1,127 @@
+"""Alternative prefix store: character trie (no eviction).
+
+Parity with reference ``pkg/tokenization/prefixstore/trie_store.go``: a
+per-model character trie where each node records the tokens that become
+fully contained once the prefix reaches that character (token ``[, high]``
+byte offset ≤ the node's byte position). Lookup walks the prompt until the
+first unseen character, collecting newly-contained tokens and the covered
+ratio. Not the default: unbounded growth and slower than the LRU store
+(reference ``docs/architecture.md:159-160``).
+
+Design deviations from the reference (both correctness fixes):
+
+- nodes store *all* newly-contained token ids at their position rather than
+  only the last one — the reference drops intermediate tokens when several
+  (e.g. zero-width specials) become contained at the same character;
+- each insert stamps its path with a generation, and lookups stop at the
+  first generation change — the reference happily splices token indexes
+  from different tokenizations that overwrote each other's shared-prefix
+  nodes, returning corrupted sequences with full overlap ratio.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from .indexer import Config, Indexer, Offset
+
+
+class _Node:
+    __slots__ = ("children", "new_tokens", "last_index", "gen")
+
+    def __init__(self):
+        self.children: dict[str, _Node] = {}
+        # token ids newly contained at this node, and the index of the last
+        # contained token in the full tokenization (-1 = none).
+        self.new_tokens: list[int] = []
+        self.last_index: int = -1
+        # generation of the insert that last wrote this node. Every insert
+        # rewrites a contiguous path from the root, so along any root path
+        # generations are non-increasing; mixing nodes from different
+        # generations would splice token indexes from different
+        # tokenizations, so lookups stop at the first generation change.
+        self.gen: int = 0
+
+
+class ContainedTokenStore(Indexer):
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        self._tries: dict[str, _Node] = {}
+        self._gen = 0
+        self._mu = threading.RLock()
+
+    def _trie(self, model_name: str, create: bool) -> Optional[_Node]:
+        trie = self._tries.get(model_name)
+        if trie is None and create:
+            trie = _Node()
+            self._tries[model_name] = trie
+        return trie
+
+    def add_tokenization(
+        self,
+        model_name: str,
+        prompt: str,
+        tokens: Sequence[int],
+        offsets: Sequence[Offset],
+    ) -> None:
+        if not prompt or not tokens:
+            return
+        if len(tokens) != len(offsets):
+            raise ValueError("tokens and offsets must be parallel")
+
+        with self._mu:
+            self._gen += 1
+            gen = self._gen
+            node = self._trie(model_name, create=True)
+            # Tokens contained before any character (zero-width specials at
+            # position 0) attach to the root.
+            k = -1
+            root_new = []
+            while k + 1 < len(tokens) and offsets[k + 1][1] <= 0:
+                k += 1
+                root_new.append(int(tokens[k]))
+            node.new_tokens = root_new
+            node.last_index = k
+            node.gen = gen
+
+            byte_pos = 0
+            for ch in prompt:
+                byte_pos += len(ch.encode("utf-8"))
+                new_here: list[int] = []
+                while k + 1 < len(tokens) and offsets[k + 1][1] <= byte_pos:
+                    k += 1
+                    new_here.append(int(tokens[k]))
+                child = node.children.get(ch)
+                if child is None:
+                    child = _Node()
+                    node.children[ch] = child
+                node = child
+                node.new_tokens = new_here
+                node.last_index = k
+                node.gen = gen
+
+    def find_longest_contained_tokens(
+        self, prompt: str, model_name: str
+    ) -> tuple[list[int], float]:
+        with self._mu:
+            node = self._trie(model_name, create=False)
+            if node is None or not prompt:
+                return [], 0.0
+
+            contained: list[int] = []
+            expected_gen = node.gen  # root carries the latest insert's gen
+            contained.extend(node.new_tokens)
+
+            matched_chars = 0
+            for ch in prompt:
+                child = node.children.get(ch)
+                if child is None or child.gen != expected_gen:
+                    # gen change = this subpath was written by a different
+                    # (older) tokenization than the nodes already collected;
+                    # splicing them would corrupt the sequence.
+                    break
+                node = child
+                matched_chars += 1
+                contained.extend(node.new_tokens)
+            return contained, matched_chars / len(prompt)

@@ -1,0 +1,63 @@
+"""Minimal in-process Redis fake covering the hash-ops subset RedisIndex
+uses (the reference's tests use miniredis the same way, ``redis_test.go``)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class FakePipeline:
+    def __init__(self, store: "FakeRedis"):
+        self._store = store
+        self._ops: list[tuple] = []
+
+    def hkeys(self, name):
+        self._ops.append(("hkeys", name))
+        return self
+
+    def hset(self, name, field, value):
+        self._ops.append(("hset", name, field, value))
+        return self
+
+    def hdel(self, name, *fields):
+        self._ops.append(("hdel", name, fields))
+        return self
+
+    def execute(self):
+        results = []
+        with self._store._lock:
+            for op in self._ops:
+                if op[0] == "hkeys":
+                    results.append(list(self._store._hashes.get(op[1], {}).keys()))
+                elif op[0] == "hset":
+                    _, name, field, value = op
+                    h = self._store._hashes.setdefault(name, {})
+                    created = field not in h
+                    h[field] = value
+                    results.append(int(created))
+                elif op[0] == "hdel":
+                    _, name, fields = op
+                    h = self._store._hashes.get(name, {})
+                    removed = sum(1 for f in fields if h.pop(f, None) is not None)
+                    if name in self._store._hashes and not h:
+                        del self._store._hashes[name]
+                    results.append(removed)
+        self._ops = []
+        return results
+
+
+class FakeRedis:
+    def __init__(self):
+        self._hashes: dict[str, dict[str, str]] = {}
+        self._lock = threading.RLock()
+
+    def ping(self):
+        return True
+
+    def pipeline(self):
+        return FakePipeline(self)
+
+    # direct (non-pipelined) variants, for completeness
+    def hkeys(self, name):
+        with self._lock:
+            return list(self._hashes.get(name, {}).keys())

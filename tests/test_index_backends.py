@@ -1,0 +1,231 @@
+"""Backend-agnostic Index conformance suite.
+
+Port of the reference's pattern (``pkg/kvcache/kvblock/index_test.go:35-63``):
+one behavioral suite instantiated for every backend — in-memory, cost-aware,
+redis (fake), and the instrumented wrapper — plus per-backend eviction-bound
+tests and a concurrency hammer.
+"""
+
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    CostAwareMemoryIndex,
+    CostAwareMemoryIndexConfig,
+    DeviceTier,
+    InMemoryIndex,
+    InMemoryIndexConfig,
+    InstrumentedIndex,
+    Key,
+    PodEntry,
+    RedisIndexConfig,
+    create_index,
+    IndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import RedisIndex
+
+from fake_redis import FakeRedis
+
+
+def _k(i: int, model="m") -> Key:
+    return Key(model, i)
+
+
+def _e(pod: str, tier: DeviceTier = DeviceTier.TPU_HBM) -> PodEntry:
+    return PodEntry(pod, tier)
+
+
+BACKENDS = {
+    "in_memory": lambda: InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10)),
+    "cost_aware": lambda: CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost_bytes=10**6)),
+    "redis": lambda: RedisIndex(RedisIndexConfig(client=FakeRedis())),
+    "instrumented": lambda: InstrumentedIndex(
+        InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+    ),
+}
+
+
+@pytest.fixture(params=list(BACKENDS))
+def index(request):
+    return BACKENDS[request.param]()
+
+
+class TestIndexConformance:
+    def test_basic_add_and_lookup(self, index):
+        keys = [_k(1), _k(2), _k(3)]
+        index.add(keys, [_e("podA")])
+        got = index.lookup(keys, set())
+        assert set(got) == set(keys)
+        for key in keys:
+            assert got[key] == ["podA"]
+
+    def test_duplicate_pod_handling(self, index):
+        index.add([_k(1)], [_e("podA")])
+        index.add([_k(1)], [_e("podA")])
+        got = index.lookup([_k(1)], set())
+        assert got[_k(1)] == ["podA"]
+
+    def test_filtered_lookup(self, index):
+        index.add([_k(1)], [_e("podA"), _e("podB"), _e("podC")])
+        got = index.lookup([_k(1)], {"podB"})
+        assert got[_k(1)] == ["podB"]
+
+    def test_filter_no_match(self, index):
+        index.add([_k(1)], [_e("podA")])
+        got = index.lookup([_k(1)], {"podZ"})
+        # no surviving pods for the key → chain considered broken
+        assert got.get(_k(1), []) == []
+
+    def test_evict_basic(self, index):
+        index.add([_k(1)], [_e("podA"), _e("podB")])
+        index.evict(_k(1), [_e("podA")])
+        got = index.lookup([_k(1)], set())
+        assert got.get(_k(1), []) == ["podB"]
+        index.evict(_k(1), [_e("podB")])
+        got = index.lookup([_k(1)], set())
+        assert got.get(_k(1), []) == []
+
+    def test_evict_missing_key_is_noop(self, index):
+        index.evict(_k(99), [_e("podA")])
+
+    def test_multiple_tiers_same_pod(self, index):
+        index.add([_k(1)], [_e("podA", DeviceTier.TPU_HBM), _e("podA", DeviceTier.HOST_DRAM)])
+        got = index.lookup([_k(1)], set())
+        # pod appears once per tier entry; dedup is the scorer's concern
+        assert set(got[_k(1)]) == {"podA"}
+        # evicting only the HBM tier keeps the DRAM entry
+        index.evict(_k(1), [_e("podA", DeviceTier.TPU_HBM)])
+        got = index.lookup([_k(1)], set())
+        assert got.get(_k(1), []) == ["podA"]
+
+    def test_concurrent_operations(self, index):
+        errors = []
+        n_threads, n_ops = 20, 25
+
+        def worker(tid: int):
+            try:
+                for i in range(n_ops):
+                    key = _k(i % 7)
+                    pod = f"pod{tid % 3}"
+                    op = (tid + i) % 3
+                    if op == 0:
+                        index.add([key], [_e(pod)])
+                    elif op == 1:
+                        index.lookup([key], set())
+                    else:
+                        index.evict(key, [_e(pod)])
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+class TestInMemorySpecifics:
+    def test_lru_eviction_bound(self):
+        idx = InMemoryIndex(InMemoryIndexConfig(size=2, pod_cache_size=10))
+        idx.add([_k(1), _k(2), _k(3)], [_e("podA")])
+        # size=2 → key 1 evicted
+        got = idx.lookup([_k(1), _k(2), _k(3)], set())
+        assert _k(1) not in got
+        assert got[_k(2)] == ["podA"]
+        assert got[_k(3)] == ["podA"]
+
+    def test_pod_cache_bound(self):
+        idx = InMemoryIndex(InMemoryIndexConfig(size=10, pod_cache_size=2))
+        idx.add([_k(1)], [_e("podA"), _e("podB"), _e("podC")])
+        got = idx.lookup([_k(1)], set())
+        assert len(got[_k(1)]) == 2  # oldest pod evicted
+
+    def test_missing_key_does_not_stop_scan(self):
+        idx = InMemoryIndex()
+        idx.add([_k(2)], [_e("podA")])
+        got = idx.lookup([_k(1), _k(2)], set())
+        # key 1 absent → skipped, scan continues (in_memory.go:132-134)
+        assert got == {_k(2): ["podA"]}
+
+    def test_lookup_empty_keys_raises(self):
+        idx = InMemoryIndex()
+        with pytest.raises(ValueError):
+            idx.lookup([], set())
+
+    def test_add_empty_raises(self):
+        idx = InMemoryIndex()
+        with pytest.raises(ValueError):
+            idx.add([], [_e("podA")])
+        with pytest.raises(ValueError):
+            idx.add([_k(1)], [])
+
+
+class TestCostAwareSpecifics:
+    def test_cost_eviction(self):
+        # Budget fits roughly one entry (key overhead ~104 + pod ~70).
+        idx = CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost_bytes=250))
+        idx.add([_k(1)], [_e("podA")])
+        idx.add([_k(2)], [_e("podA")])
+        got = idx.lookup([_k(1), _k(2)], set())
+        assert _k(1) not in got  # LRU-evicted by cost pressure
+        assert got[_k(2)] == ["podA"]
+
+    def test_total_cost_tracks_evictions(self):
+        idx = CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost_bytes=10**6))
+        idx.add([_k(1), _k(2)], [_e("podA")])
+        c2 = idx.total_cost
+        idx.evict(_k(1), [_e("podA")])
+        assert idx.total_cost < c2
+        idx.evict(_k(2), [_e("podA")])
+        assert idx.total_cost == 0
+
+
+class TestRedisSpecifics:
+    def test_missing_key_stops_scan(self):
+        idx = RedisIndex(RedisIndexConfig(client=FakeRedis()))
+        idx.add([_k(2)], [_e("podA")])
+        # redis cannot distinguish missing from empty → chain breaks at key 1
+        got = idx.lookup([_k(1), _k(2)], set())
+        assert got == {}
+
+    def test_empty_lookup_returns_empty(self):
+        idx = RedisIndex(RedisIndexConfig(client=FakeRedis()))
+        assert idx.lookup([], set()) == {}
+
+
+class TestFactory:
+    def test_default_is_in_memory(self):
+        idx = create_index()
+        assert isinstance(idx, InMemoryIndex)
+
+    def test_priority_order(self):
+        idx = create_index(
+            IndexConfig(
+                in_memory=InMemoryIndexConfig(),
+                cost_aware=CostAwareMemoryIndexConfig(),
+            )
+        )
+        assert isinstance(idx, InMemoryIndex)
+
+    def test_cost_aware_selected(self):
+        idx = create_index(IndexConfig(in_memory=None, cost_aware=CostAwareMemoryIndexConfig()))
+        assert isinstance(idx, CostAwareMemoryIndex)
+
+    def test_no_backend_raises(self):
+        with pytest.raises(ValueError):
+            create_index(IndexConfig(in_memory=None))
+
+    def test_metrics_wrapper(self):
+        idx = create_index(IndexConfig(enable_metrics=True))
+        assert isinstance(idx, InstrumentedIndex)
+        idx.add([_k(1)], [_e("podA")])
+        got = idx.lookup([_k(1)], set())
+        assert got[_k(1)] == ["podA"]
+        from llm_d_kv_cache_manager_tpu.kvcache.metrics import collector
+
+        snap = collector.snapshot()
+        assert snap["admissions"] >= 1
+        assert snap["lookup_requests"] >= 1
+        assert snap["lookup_hits"] >= 1

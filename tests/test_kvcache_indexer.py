@@ -1,0 +1,107 @@
+"""End-to-end read-path tests for KVCacheIndexer (no network): mirrors the
+reference e2e suite's CacheHit/CacheMiss/PrefixReduction scenarios
+(``tests/e2e/redis_mock/e2e_test.go``) with a mock tokenizer."""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    DeviceTier,
+    PodEntry,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore import Config as PSConfig
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore import LRUTokenStore
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import KVCacheIndexerConfig
+from llm_d_kv_cache_manager_tpu.tokenization.pool import TokenizationPoolConfig
+
+MODEL = "test-model"
+BLOCK = 4  # small token block size, like the reference e2e (block size 4)
+
+
+class CharTokenizer(Tokenizer):
+    def encode(self, prompt, model_name):
+        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
+
+
+@pytest.fixture
+def indexer():
+    cfg = KVCacheIndexerConfig(
+        token_processor=TokenProcessorConfig(block_size=BLOCK),
+        tokenization_pool=TokenizationPoolConfig(workers_count=2),
+    )
+    ix = KVCacheIndexer(cfg, tokenizer=CharTokenizer(), prefix_store=LRUTokenStore(PSConfig(block_size=4)))
+    ix.run()
+    yield ix
+    ix.shutdown()
+
+
+def _prompt_to_keys(indexer, prompt):
+    tokens = [ord(c) for c in prompt]
+    return indexer.token_processor.tokens_to_kv_block_keys(tokens, MODEL)
+
+
+class TestReadPath:
+    def test_cache_miss_scores_empty(self, indexer):
+        scores = indexer.get_pod_scores("hello world padded!!", MODEL)
+        assert scores == {}
+
+    def test_cache_hit_scores_pod(self, indexer):
+        prompt = "abcdefghijklmnop"  # 4 blocks of 4 tokens
+        keys = _prompt_to_keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1", DeviceTier.TPU_HBM)])
+        scores = indexer.get_pod_scores(prompt, MODEL)
+        assert scores == {"pod-1": 4}
+
+    def test_prefix_reduction(self, indexer):
+        prompt = "abcdefghijklmnop"
+        keys = _prompt_to_keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1")])
+        # Evict the last two blocks → score drops to 2.
+        for key in keys[2:]:
+            indexer.kv_block_index.evict(key, [PodEntry("pod-1")])
+        scores = indexer.get_pod_scores(prompt, MODEL)
+        assert scores == {"pod-1": 2}
+
+    def test_prefix_expansion_longer_prompt(self, indexer):
+        short = "abcdefgh"  # 2 blocks
+        longer = short + "ijklmnop"  # 4 blocks
+        indexer.kv_block_index.add(_prompt_to_keys(indexer, short), [PodEntry("pod-1")])
+        scores = indexer.get_pod_scores(longer, MODEL)
+        assert scores == {"pod-1": 2}
+
+    def test_pod_filter(self, indexer):
+        prompt = "abcdefgh"
+        keys = _prompt_to_keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1"), PodEntry("pod-2")])
+        scores = indexer.get_pod_scores(prompt, MODEL, pod_identifiers=["pod-2"])
+        assert scores == {"pod-2": 2}
+
+    def test_two_pods_different_depths(self, indexer):
+        prompt = "abcdefghijklmnop"
+        keys = _prompt_to_keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-deep")])
+        indexer.kv_block_index.add(keys[:1], [PodEntry("pod-shallow")])
+        scores = indexer.get_pod_scores(prompt, MODEL)
+        assert scores == {"pod-deep": 4, "pod-shallow": 1}
+
+    def test_short_prompt_no_blocks(self, indexer):
+        assert indexer.get_pod_scores("ab", MODEL) == {}
+
+    def test_score_tokens_matches_get_pod_scores(self, indexer):
+        prompt = "abcdefgh"
+        keys = _prompt_to_keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1")])
+        via_prompt = indexer.get_pod_scores(prompt, MODEL)
+        via_tokens = indexer.score_tokens([ord(c) for c in prompt], MODEL)
+        assert via_prompt == via_tokens == {"pod-1": 2}
+
+    def test_long_prefix(self, indexer):
+        # ~4.5k-token analogue of the reference LongPrefix e2e test.
+        prompt = ("abcdefghijklmnopqrstuvwxyz" * 200)[:4500]
+        keys = _prompt_to_keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1")])
+        scores = indexer.get_pod_scores(prompt, MODEL)
+        assert scores == {"pod-1": len(keys)}
+        assert len(keys) == 4500 // BLOCK

@@ -1,0 +1,142 @@
+"""Prefix-store tests (reference ``lru_store_test.go``) for both stores."""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.tokenization.prefixstore import (
+    Config,
+    ContainedTokenStore,
+    LRUTokenStore,
+)
+
+
+def _fixture(block_size=4):
+    """Deterministic prompt/token/offset fixture: 1 token per 2 bytes."""
+    prompt = "abcdefghijklmnop"  # 16 bytes
+    tokens = list(range(100, 108))  # 8 tokens
+    offsets = [(i * 2, i * 2 + 2) for i in range(8)]
+    return prompt, tokens, offsets
+
+
+class TestLRUTokenStore:
+    def test_full_match(self):
+        store = LRUTokenStore(Config(block_size=4))
+        prompt, tokens, offsets = _fixture()
+        store.add_tokenization("m", prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens(prompt, "m")
+        assert got == tokens
+        assert ratio == 1.0
+
+    def test_partial_match_ratio(self):
+        store = LRUTokenStore(Config(block_size=4))
+        prompt, tokens, offsets = _fixture()
+        store.add_tokenization("m", prompt, tokens, offsets)
+        # Same first 8 bytes (2 blocks), divergent afterwards.
+        probe = prompt[:8] + "XXXXXXXX"
+        got, ratio = store.find_longest_contained_tokens(probe, "m")
+        assert got == tokens[:4]
+        assert ratio == 0.5
+
+    def test_no_match(self):
+        store = LRUTokenStore(Config(block_size=4))
+        prompt, tokens, offsets = _fixture()
+        store.add_tokenization("m", prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens("ZZZZZZZZ", "m")
+        assert got == []
+        assert ratio == 0.0
+
+    def test_unknown_model(self):
+        store = LRUTokenStore()
+        got, ratio = store.find_longest_contained_tokens("abc", "nope")
+        assert (got, ratio) == ([], 0.0)
+
+    def test_short_prompt_no_full_block(self):
+        store = LRUTokenStore(Config(block_size=256))
+        store.add_tokenization("m", "short", [1], [(0, 5)])
+        got, ratio = store.find_longest_contained_tokens("short", "m")
+        assert (got, ratio) == ([], 0.0)
+
+    def test_token_spanning_block_boundary_deferred(self):
+        # Token with high offset beyond block end lands in the next block.
+        store = LRUTokenStore(Config(block_size=4))
+        prompt = "abcdefgh"
+        tokens = [1, 2]
+        offsets = [(0, 3), (3, 6)]  # token 2 crosses the 4-byte boundary
+        store.add_tokenization("m", prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens(prompt[:4] + "XXXX", "m")
+        assert got == [1]  # token 2 only contained in block 2, which missed
+
+    def test_eviction(self):
+        store = LRUTokenStore(Config(block_size=4, cache_size=2))
+        prompt, tokens, offsets = _fixture()
+        store.add_tokenization("m", prompt, tokens, offsets)  # 4 blocks → only 2 kept
+        got, ratio = store.find_longest_contained_tokens(prompt, "m")
+        # first blocks were evicted → chain breaks immediately
+        assert got == []
+        assert ratio == 0.0
+
+    def test_multibyte_prompt_uses_byte_blocks(self):
+        store = LRUTokenStore(Config(block_size=4))
+        prompt = "ééé"  # 3 chars, 6 bytes → one full 4-byte block
+        tokens = [7]
+        offsets = [(0, 2)]  # first é in bytes
+        store.add_tokenization("m", prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens(prompt, "m")
+        assert got == [7]
+        assert ratio == pytest.approx(4 / 6)
+
+    def test_mismatched_lengths_raise(self):
+        store = LRUTokenStore()
+        with pytest.raises(ValueError):
+            store.add_tokenization("m", "abc", [1, 2], [(0, 1)])
+
+
+class TestContainedTokenStore:
+    def test_full_match(self):
+        store = ContainedTokenStore()
+        prompt, tokens, offsets = _fixture()
+        store.add_tokenization("m", prompt, tokens, offsets)
+        got, ratio = store.find_longest_contained_tokens(prompt, "m")
+        assert got == tokens
+        assert ratio == 1.0
+
+    def test_partial_match(self):
+        store = ContainedTokenStore()
+        prompt, tokens, offsets = _fixture()
+        store.add_tokenization("m", prompt, tokens, offsets)
+        probe = prompt[:6] + "ZZZ"
+        got, ratio = store.find_longest_contained_tokens(probe, "m")
+        # 6 chars matched → tokens with high ≤ 6 contained
+        assert got == tokens[:3]
+        assert ratio == pytest.approx(6 / 9)
+
+    def test_zero_width_special_tokens_at_root(self):
+        store = ContainedTokenStore()
+        # CLS-style token with (0,0) offset, then a real token.
+        store.add_tokenization("m", "ab", [101, 5], [(0, 0), (0, 2)])
+        got, ratio = store.find_longest_contained_tokens("ab", "m")
+        assert got == [101, 5]
+
+    def test_no_intermediate_token_skipping(self):
+        store = ContainedTokenStore()
+        # Two tokens end at the same char position (zero-width second token):
+        # both must be returned, in order.
+        store.add_tokenization("m", "ab", [1, 2, 3], [(0, 1), (1, 1), (1, 2)])
+        got, _ = store.find_longest_contained_tokens("ab", "m")
+        assert got == [1, 2, 3]
+
+    def test_no_cross_tokenization_splicing(self):
+        # Overlapping inserts must never splice tokens from different
+        # tokenizations into one returned sequence.
+        store = ContainedTokenStore()
+        store.add_tokenization("m", "abcd", [10, 11], [(0, 2), (2, 4)])
+        store.add_tokenization("m", "abe", [20, 21], [(0, 1), (1, 3)])
+        got, ratio = store.find_longest_contained_tokens("abcd", "m")
+        # The newer insert overwrote the shared 'a'/'b' nodes; the walk must
+        # stop at the generation change instead of returning [20, 11].
+        assert got in ([], [20], [20, 21])  # never a spliced sequence
+        assert 11 not in got
+        assert ratio < 1.0
+        # The newer tokenization itself is fully retrievable.
+        got2, ratio2 = store.find_longest_contained_tokens("abe", "m")
+        assert got2 == [20, 21]
+        assert ratio2 == 1.0

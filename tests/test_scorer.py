@@ -1,0 +1,56 @@
+"""LongestPrefixScorer unit tests (reference ``kvblock_scorer_test.go:35-60``)."""
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache import (
+    KVBlockScorerConfig,
+    LongestPrefixScorer,
+    ScoringStrategy,
+    new_scorer,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import Key
+
+
+def _keys(n):
+    return [Key("m", i) for i in range(n)]
+
+
+class TestLongestPrefixScorer:
+    def test_consecutive_vs_gap(self):
+        keys = _keys(3)
+        # podA hits all 3 consecutively; podB hits only blocks 1,2 (not 0).
+        hits = {
+            keys[0]: ["podA"],
+            keys[1]: ["podA", "podB"],
+            keys[2]: ["podA", "podB"],
+        }
+        scores = LongestPrefixScorer().score(keys, hits)
+        assert scores == {"podA": 3}
+        assert scores.get("podB", 0) == 0
+
+    def test_streak_breaks_mid_chain(self):
+        keys = _keys(4)
+        hits = {
+            keys[0]: ["podA", "podB"],
+            keys[1]: ["podA", "podB"],
+            keys[2]: ["podA"],
+            keys[3]: ["podA"],
+        }
+        scores = LongestPrefixScorer().score(keys, hits)
+        assert scores == {"podA": 4, "podB": 2}
+
+    def test_empty_keys(self):
+        assert LongestPrefixScorer().score([], {}) == {}
+
+    def test_no_hits(self):
+        assert LongestPrefixScorer().score(_keys(3), {}) == {}
+
+    def test_missing_middle_key_breaks_all(self):
+        keys = _keys(3)
+        hits = {keys[0]: ["podA"], keys[2]: ["podA"]}
+        scores = LongestPrefixScorer().score(keys, hits)
+        assert scores == {"podA": 1}
+
+    def test_factory(self):
+        s = new_scorer(KVBlockScorerConfig())
+        assert s.strategy == ScoringStrategy.LONGEST_PREFIX
