@@ -1,0 +1,98 @@
+"""Offline KV-events demo: an in-process publisher simulating a TPU serving
+pod, driving the full write path + read path.
+
+Mirrors the reference demo (``examples/kv_events/offline/main.go:150-239``):
+score (empty) → publish BlockStored → score (hits) → publish BlockRemoved
+for the tail blocks → score (reduced). This is the behavioral acceptance
+test for the whole pipeline.
+
+Run: ``python examples/offline_events_demo.py``
+"""
+
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import TokenProcessorConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    BlockRemoved,
+    BlockStored,
+    KVEventsPool,
+    KVEventsPoolConfig,
+    ZMQPublisher,
+    ZMQPublisherConfig,
+    ZMQSubscriber,
+    ZMQSubscriberConfig,
+)
+from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
+
+MODEL = "meta-llama/Llama-3.1-8B-Instruct"
+POD = "tpu-pod-1"
+PORT = 5557
+
+
+class CharTokenizer(Tokenizer):
+    """Offline stand-in for the HF tokenizer (no network in the demo)."""
+
+    def encode(self, prompt, model_name):
+        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
+
+
+def main() -> int:
+    indexer = KVCacheIndexer(
+        KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=16)),
+        tokenizer=CharTokenizer(),
+    )
+    indexer.run()
+    pool = KVEventsPool(indexer.kv_block_index, KVEventsPoolConfig())
+    pool.start()
+    sub = ZMQSubscriber(pool, ZMQSubscriberConfig(endpoint=f"tcp://*:{PORT}"))
+    sub.start()
+
+    prompt = "You are a helpful TPU serving assistant. " * 4
+    tokens = [ord(c) for c in prompt]
+    keys = indexer.token_processor.tokens_to_kv_block_keys(tokens, MODEL)
+    hashes = [k.chunk_hash for k in keys]
+
+    print(f"[demo] prompt of {len(tokens)} tokens → {len(keys)} blocks")
+    print("[demo] scores before any events:", indexer.get_pod_scores(prompt, MODEL))
+
+    pub = ZMQPublisher(
+        ZMQPublisherConfig(
+            endpoint=f"tcp://localhost:{PORT}", pod_identifier=POD, model_name=MODEL
+        )
+    )
+
+    scores = {}
+    deadline = time.time() + 20
+    while time.time() < deadline and not scores:
+        pub.publish([BlockStored(block_hashes=hashes, token_ids=tokens, block_size=16)])
+        time.sleep(0.2)
+        scores = indexer.get_pod_scores(prompt, MODEL)
+    print("[demo] scores after BlockStored:", scores)
+    assert scores.get(POD) == len(keys), "expected full-prefix hit"
+
+    half = len(hashes) // 2
+    pub.publish([BlockRemoved(block_hashes=hashes[half:])])
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        scores = indexer.get_pod_scores(prompt, MODEL)
+        if scores.get(POD) == half:
+            break
+        time.sleep(0.1)
+    print("[demo] scores after BlockRemoved of tail:", scores)
+    assert scores.get(POD) == half, "expected reduced prefix hit"
+
+    pub.close()
+    sub.shutdown()
+    pool.shutdown()
+    indexer.shutdown()
+    print("[demo] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+from .events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    Event,
+    EventBatch,
+    decode_event_batch,
+)
+from .pool import KVEventsPool, KVEventsPoolConfig, Message, fnv1a_32
+from .zmq_subscriber import ZMQSubscriber, ZMQSubscriberConfig, parse_topic
+from .publisher import ZMQPublisher, ZMQPublisherConfig
+
+__all__ = [
+    "AllBlocksCleared",
+    "BlockRemoved",
+    "BlockStored",
+    "Event",
+    "EventBatch",
+    "decode_event_batch",
+    "KVEventsPool",
+    "KVEventsPoolConfig",
+    "Message",
+    "fnv1a_32",
+    "ZMQSubscriber",
+    "ZMQSubscriberConfig",
+    "parse_topic",
+    "ZMQPublisher",
+    "ZMQPublisherConfig",
+]

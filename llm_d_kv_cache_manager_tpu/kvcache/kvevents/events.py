@@ -1,0 +1,149 @@
+"""KV-event schema: msgpack tagged-union wire format.
+
+Parity with reference ``pkg/kvcache/kvevents/events.go``: events travel as
+msgpack *array-encoded* structs matching the serving engine's publisher —
+
+- ``EventBatch``: ``[ts, [event, ...], data_parallel_rank?]``
+- ``BlockStored``: ``["BlockStored", block_hashes, parent_block_hash,
+  token_ids, block_size, lora_id?, medium?]``
+- ``BlockRemoved``: ``["BlockRemoved", block_hashes, medium?]``
+- ``AllBlocksCleared``: ``["AllBlocksCleared"]``
+
+Decoding is positional and tolerant: trailing optional fields may be absent
+(the reference's "legacy" variants, ``events.go:113-153``) and unknown extra
+fields are ignored — this subsumes the reference's arity-sniffing legacy
+dispatch (``pool.go:308-317``) without duplicating event types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Union
+
+import msgpack
+
+BLOCK_STORED_TAG = "BlockStored"
+BLOCK_REMOVED_TAG = "BlockRemoved"
+ALL_BLOCKS_CLEARED_TAG = "AllBlocksCleared"
+
+
+@dataclass
+class BlockStored:
+    block_hashes: list[int]
+    parent_block_hash: Optional[int] = None
+    token_ids: list[int] = field(default_factory=list)
+    block_size: int = 0
+    lora_id: Optional[int] = None
+    medium: Optional[str] = None
+
+    def to_tagged_union(self) -> list[Any]:
+        return [
+            BLOCK_STORED_TAG,
+            self.block_hashes,
+            self.parent_block_hash,
+            self.token_ids,
+            self.block_size,
+            self.lora_id,
+            self.medium,
+        ]
+
+
+@dataclass
+class BlockRemoved:
+    block_hashes: list[int]
+    medium: Optional[str] = None
+
+    def to_tagged_union(self) -> list[Any]:
+        return [BLOCK_REMOVED_TAG, self.block_hashes, self.medium]
+
+
+@dataclass
+class AllBlocksCleared:
+    def to_tagged_union(self) -> list[Any]:
+        return [ALL_BLOCKS_CLEARED_TAG]
+
+
+Event = Union[BlockStored, BlockRemoved, AllBlocksCleared]
+
+
+@dataclass
+class EventBatch:
+    ts: float
+    events: list[Event]
+    data_parallel_rank: Optional[int] = None
+
+    def to_payload(self) -> bytes:
+        """Serialize to the wire format (array-encoded, like the engine)."""
+        arr = [self.ts, [e.to_tagged_union() for e in self.events]]
+        if self.data_parallel_rank is not None:
+            arr.append(self.data_parallel_rank)
+        return msgpack.packb(arr, use_bin_type=True)
+
+
+def _get(parts: Sequence, idx: int, default=None):
+    return parts[idx] if idx < len(parts) else default
+
+
+def _decode_event(raw) -> Optional[Event]:
+    """Decode one tagged-union event; None for malformed/unknown events."""
+    if isinstance(raw, (bytes, bytearray)):
+        raw = msgpack.unpackb(raw, raw=False)
+    if not isinstance(raw, (list, tuple)) or not raw:
+        return None
+    tag = raw[0]
+    if isinstance(tag, bytes):
+        tag = tag.decode("utf-8", "replace")
+    fields = raw[1:]
+    if tag == BLOCK_STORED_TAG:
+        hashes = _get(fields, 0)
+        if not isinstance(hashes, (list, tuple)):
+            return None
+        medium = _get(fields, 5)
+        if isinstance(medium, bytes):
+            medium = medium.decode("utf-8", "replace")
+        return BlockStored(
+            block_hashes=[int(h) for h in hashes],
+            parent_block_hash=_get(fields, 1),
+            token_ids=list(_get(fields, 2) or []),
+            block_size=int(_get(fields, 3) or 0),
+            lora_id=_get(fields, 4),
+            medium=medium,
+        )
+    if tag == BLOCK_REMOVED_TAG:
+        hashes = _get(fields, 0)
+        if not isinstance(hashes, (list, tuple)):
+            return None
+        medium = _get(fields, 1)
+        if isinstance(medium, bytes):
+            medium = medium.decode("utf-8", "replace")
+        return BlockRemoved(block_hashes=[int(h) for h in hashes], medium=medium)
+    if tag == ALL_BLOCKS_CLEARED_TAG:
+        return AllBlocksCleared()
+    return None  # unknown tag
+
+
+def decode_event_batch(payload: bytes) -> Optional[EventBatch]:
+    """Decode a wire payload; returns None for poison pills (undecodable).
+
+    Malformed/unknown events inside an otherwise-valid batch are skipped,
+    mirroring the reference's per-event tolerance (``pool.go:183-243``).
+    """
+    try:
+        arr = msgpack.unpackb(payload, raw=False)
+    except Exception:
+        return None
+    if not isinstance(arr, (list, tuple)) or len(arr) < 2:
+        return None
+    ts, raw_events = arr[0], arr[1]
+    if not isinstance(raw_events, (list, tuple)) or not isinstance(ts, (int, float)):
+        return None
+    events = []
+    for raw in raw_events:
+        try:
+            ev = _decode_event(raw)
+        except Exception:
+            ev = None
+        if ev is not None:
+            events.append(ev)
+    dp_rank = arr[2] if len(arr) > 2 else None
+    return EventBatch(ts=float(ts), events=events, data_parallel_rank=dp_rank)

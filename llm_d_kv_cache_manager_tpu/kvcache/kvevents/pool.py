@@ -1,0 +1,165 @@
+"""Event-ingestion worker pool: sharded, per-pod ordered.
+
+Parity with reference ``pkg/kvcache/kvevents/pool.go``: incoming messages
+are sharded by FNV-1a(pod id) onto per-worker FIFO queues so events for one
+pod are always applied in order (``pool.go:125-137``); workers decode the
+msgpack batch and apply Add/Evict to the block index. Poison pills are
+dropped, not retried (``:174-180``).
+
+TPU retarget: the pod entry tier comes from the event's ``medium`` field
+({tpu_hbm, host_dram}) rather than the reference's hardcoded ``"gpu"``
+(``pool.go:247``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from ...utils import get_logger
+from ..kvblock import DeviceTier, Index, Key, PodEntry, tier_for_medium
+from .events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    decode_event_batch,
+)
+
+log = get_logger("kvcache.kvevents.pool")
+
+DEFAULT_CONCURRENCY = 4
+
+
+def fnv1a_32(data: bytes) -> int:
+    """FNV-1a 32-bit (matches Go ``hash/fnv.New32a``)."""
+    h = 0x811C9DC5
+    for b in data:
+        h ^= b
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+@dataclass
+class Message:
+    """One raw event message from the transport
+    (reference ``zmq_subscriber.go`` Message)."""
+
+    topic: str
+    pod_identifier: str
+    model_name: str
+    payload: bytes
+    seq: int = 0
+
+
+@dataclass
+class KVEventsPoolConfig:
+    concurrency: int = DEFAULT_CONCURRENCY
+    # Transport config is attached by the subscriber layer (zmq_subscriber).
+
+
+class KVEventsPool:
+    """Sharded ordered worker pool applying KV events to the index."""
+
+    def __init__(self, index: Index, config: Optional[KVEventsPoolConfig] = None):
+        self.config = config or KVEventsPoolConfig()
+        if self.config.concurrency < 1:
+            raise ValueError("concurrency must be >= 1")
+        self.index = index
+        self._queues: list["queue.Queue[Optional[Message]]"] = [
+            queue.Queue() for _ in range(self.config.concurrency)
+        ]
+        self._threads: list[threading.Thread] = []
+        self._running = False
+        self._mu = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        with self._mu:
+            if self._running:
+                return
+            self._running = True
+            for i in range(self.config.concurrency):
+                t = threading.Thread(
+                    target=self._worker, args=(i,), name=f"kvevents-worker-{i}", daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+    def shutdown(self) -> None:
+        with self._mu:
+            if not self._running:
+                return
+            self._running = False
+            for q in self._queues:
+                q.put(None)
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join(timeout=5)
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until all queued *and in-flight* events have been applied."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(q.unfinished_tasks == 0 for q in self._queues):
+                return True
+            time.sleep(0.002)
+        return False
+
+    # -- ingestion ----------------------------------------------------------
+    def add_task(self, msg: Message) -> None:
+        """Shard by pod id so per-pod ordering holds."""
+        shard = fnv1a_32(msg.pod_identifier.encode("utf-8")) % self.config.concurrency
+        self._queues[shard].put(msg)
+
+    def _worker(self, shard: int) -> None:
+        q = self._queues[shard]
+        while True:
+            msg = q.get()
+            if msg is None:
+                q.task_done()
+                return
+            try:
+                self._process_event(msg)
+            except Exception:
+                # Poison pill or backend failure on one message must not kill
+                # the worker; drop and continue (reference pool.go:174-180).
+                log.exception("failed to process event message; dropping")
+            finally:
+                q.task_done()
+
+    def _process_event(self, msg: Message) -> None:
+        batch = decode_event_batch(msg.payload)
+        if batch is None:
+            log.debug("failed to unmarshal event batch, dropping message", topic=msg.topic)
+            return
+
+        for ev in batch.events:
+            if isinstance(ev, BlockStored):
+                keys = [Key(msg.model_name, h) for h in ev.block_hashes]
+                entries = [PodEntry(msg.pod_identifier, tier_for_medium(ev.medium))]
+                try:
+                    self.index.add(keys, entries)
+                except Exception:
+                    log.exception("failed to add event to index", pod=msg.pod_identifier)
+            elif isinstance(ev, BlockRemoved):
+                if ev.medium is None:
+                    # No medium (incl. legacy events) = the pod no longer
+                    # holds the block at all: clear every tier, else an entry
+                    # stored with an explicit medium would never match the
+                    # eviction and stale locality would persist forever.
+                    entries = [PodEntry(msg.pod_identifier, t) for t in DeviceTier]
+                else:
+                    entries = [PodEntry(msg.pod_identifier, tier_for_medium(ev.medium))]
+                for h in ev.block_hashes:
+                    try:
+                        self.index.evict(Key(msg.model_name, h), entries)
+                    except Exception:
+                        log.exception("failed to evict from index", pod=msg.pod_identifier)
+            elif isinstance(ev, AllBlocksCleared):
+                # No-op, as in the reference (pool.go:300-301): the event
+                # carries no hash list, and the index ages entries out.
+                continue

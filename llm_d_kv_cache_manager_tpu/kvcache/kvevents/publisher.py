@@ -1,0 +1,63 @@
+"""ZMQ PUB publisher for KV events.
+
+Counterpart of the subscriber: used by the in-tree JAX serving engine's
+block manager to announce block stores/evictions, and by demos/tests to
+simulate a fleet (reference ``examples/kv_events/offline/publisher.go``).
+Publishers **connect** to the subscriber's bound endpoint; each message is
+3 frames ``[topic, seq (8B big-endian), msgpack payload]`` with a
+monotonically increasing per-publisher sequence number.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ...utils import get_logger
+from .events import Event, EventBatch
+
+log = get_logger("kvcache.kvevents.publisher")
+
+
+@dataclass
+class ZMQPublisherConfig:
+    endpoint: str = "tcp://localhost:5557"
+    pod_identifier: str = "local-pod"
+    model_name: str = "unknown-model"
+    # Rank of this publisher in a data-parallel fleet, tagged onto batches.
+    data_parallel_rank: Optional[int] = None
+
+
+class ZMQPublisher:
+    def __init__(self, config: ZMQPublisherConfig):
+        import zmq
+
+        self.config = config
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        self._sock.connect(config.endpoint)
+        self._seq = 0
+        self._mu = threading.Lock()
+        self.topic = f"kv@{config.pod_identifier}@{config.model_name}"
+
+    def publish(self, events: list[Event], ts: Optional[float] = None) -> int:
+        """Publish one EventBatch; returns the sequence number used."""
+        batch = EventBatch(
+            ts=ts if ts is not None else time.time(),
+            events=events,
+            data_parallel_rank=self.config.data_parallel_rank,
+        )
+        payload = batch.to_payload()
+        with self._mu:
+            seq = self._seq
+            self._seq += 1
+            self._sock.send_multipart(
+                [self.topic.encode("utf-8"), struct.pack(">Q", seq), payload]
+            )
+        return seq
+
+    def close(self) -> None:
+        self._sock.close(linger=100)

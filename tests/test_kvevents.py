@@ -1,0 +1,286 @@
+"""Event plane tests: schema round-trip, legacy tolerance, sharded ordering,
+poison pills, and the end-to-end ZMQ offline-demo flow (reference §3.5)."""
+
+import struct
+import threading
+import time
+
+import msgpack
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    DeviceTier,
+    InMemoryIndex,
+    Key,
+    PodEntry,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+    KVEventsPool,
+    KVEventsPoolConfig,
+    Message,
+    ZMQPublisher,
+    ZMQPublisherConfig,
+    ZMQSubscriber,
+    ZMQSubscriberConfig,
+    decode_event_batch,
+    fnv1a_32,
+    parse_topic,
+)
+
+MODEL = "meta-llama/Llama-3-8B"
+
+
+class TestEventSchema:
+    def test_round_trip(self):
+        batch = EventBatch(
+            ts=123.5,
+            events=[
+                BlockStored(
+                    block_hashes=[1, 2, 3],
+                    parent_block_hash=7,
+                    token_ids=[10, 11],
+                    block_size=16,
+                    medium="tpu_hbm",
+                ),
+                BlockRemoved(block_hashes=[2], medium="host_dram"),
+                AllBlocksCleared(),
+            ],
+            data_parallel_rank=1,
+        )
+        decoded = decode_event_batch(batch.to_payload())
+        assert decoded.ts == 123.5
+        assert decoded.data_parallel_rank == 1
+        bs, br, ac = decoded.events
+        assert bs == batch.events[0]
+        assert br == batch.events[1]
+        assert isinstance(ac, AllBlocksCleared)
+
+    def test_legacy_block_stored_without_medium(self):
+        # Legacy arity: [tag, hashes, parent, tokens, block_size, lora_id]
+        raw = [1000.0, [["BlockStored", [5, 6], None, [1, 2], 16, None]]]
+        decoded = decode_event_batch(msgpack.packb(raw))
+        (ev,) = decoded.events
+        assert ev.block_hashes == [5, 6]
+        assert ev.medium is None
+
+    def test_legacy_block_removed_minimal(self):
+        raw = [1000.0, [["BlockRemoved", [5]]]]
+        decoded = decode_event_batch(msgpack.packb(raw))
+        (ev,) = decoded.events
+        assert ev.block_hashes == [5]
+        assert ev.medium is None
+
+    def test_unknown_tag_skipped(self):
+        raw = [1.0, [["FutureEvent", 1, 2], ["BlockRemoved", [9]]]]
+        decoded = decode_event_batch(msgpack.packb(raw))
+        assert len(decoded.events) == 1
+        assert decoded.events[0].block_hashes == [9]
+
+    def test_poison_pill_returns_none(self):
+        assert decode_event_batch(b"\xff\xfe not msgpack") is None
+        assert decode_event_batch(msgpack.packb("just a string")) is None
+        assert decode_event_batch(msgpack.packb([1.0])) is None
+        assert decode_event_batch(msgpack.packb(["not-a-ts", []])) is None
+        assert decode_event_batch(msgpack.packb([None, []])) is None
+
+    def test_nested_raw_event_bytes(self):
+        # Events may arrive as embedded msgpack blobs (reference RawMessage).
+        inner = msgpack.packb(["BlockRemoved", [4], None])
+        decoded = decode_event_batch(msgpack.packb([1.0, [inner]]))
+        assert decoded.events[0].block_hashes == [4]
+
+    def test_uint64_hashes_survive(self):
+        big = 2**64 - 1
+        batch = EventBatch(ts=0.0, events=[BlockStored(block_hashes=[big])])
+        decoded = decode_event_batch(batch.to_payload())
+        assert decoded.events[0].block_hashes == [big]
+
+
+class TestFNV:
+    def test_known_vectors(self):
+        # Standard FNV-1a 32-bit test vectors.
+        assert fnv1a_32(b"") == 0x811C9DC5
+        assert fnv1a_32(b"a") == 0xE40C292C
+        assert fnv1a_32(b"foobar") == 0xBF9CF968
+
+
+class TestTopicParsing:
+    def test_valid(self):
+        assert parse_topic("kv@pod-1@meta-llama/Llama-3-8B") == ("pod-1", "meta-llama/Llama-3-8B")
+
+    def test_model_with_at(self):
+        assert parse_topic("kv@pod@org/model@rev") == ("pod", "org/model@rev")
+
+    def test_invalid(self):
+        assert parse_topic("kv@podonly") is None
+        assert parse_topic("nonsense") is None
+        assert parse_topic("kv@@model") is None
+
+
+def _stored_payload(hashes, medium=None):
+    return EventBatch(
+        ts=time.time(), events=[BlockStored(block_hashes=hashes, medium=medium)]
+    ).to_payload()
+
+
+def _removed_payload(hashes, medium=None):
+    return EventBatch(
+        ts=time.time(), events=[BlockRemoved(block_hashes=hashes, medium=medium)]
+    ).to_payload()
+
+
+class TestKVEventsPool:
+    def test_add_and_remove_flow(self):
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=2))
+        pool.start()
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _stored_payload([1, 2, 3])))
+            assert pool.drain()
+            got = index.lookup([Key(MODEL, h) for h in (1, 2, 3)], set())
+            assert all(got[Key(MODEL, h)] == ["pod-1"] for h in (1, 2, 3))
+
+            pool.add_task(Message("t", "pod-1", MODEL, _removed_payload([2])))
+            assert pool.drain()
+            got = index.lookup([Key(MODEL, 2)], set())
+            assert got.get(Key(MODEL, 2), []) == []
+        finally:
+            pool.shutdown()
+
+    def test_medium_maps_to_tier(self):
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _stored_payload([7], medium="host_dram")))
+            assert pool.drain()
+            # evicting the hbm-tier entry must not remove the dram-tier entry
+            index.evict(Key(MODEL, 7), [PodEntry("pod-1", DeviceTier.TPU_HBM)])
+            got = index.lookup([Key(MODEL, 7)], set())
+            assert got[Key(MODEL, 7)] == ["pod-1"]
+        finally:
+            pool.shutdown()
+
+    def test_mediumless_remove_clears_all_tiers(self):
+        # A legacy BlockRemoved (no medium) must evict the pod's entry even
+        # when the block was stored with an explicit medium.
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _stored_payload([7], medium="host_dram")))
+            assert pool.drain()
+            pool.add_task(Message("t", "pod-1", MODEL, _removed_payload([7])))  # no medium
+            assert pool.drain()
+            got = index.lookup([Key(MODEL, 7)], set())
+            assert got.get(Key(MODEL, 7), []) == []
+        finally:
+            pool.shutdown()
+
+    def test_poison_pill_does_not_kill_worker(self):
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, b"\x00garbage"))
+            pool.add_task(Message("t", "pod-1", MODEL, _stored_payload([42])))
+            assert pool.drain()
+            got = index.lookup([Key(MODEL, 42)], set())
+            assert got[Key(MODEL, 42)] == ["pod-1"]
+        finally:
+            pool.shutdown()
+
+    def test_per_pod_ordering_under_concurrency(self):
+        """Store/remove pairs for one pod must apply in order even with many
+        interleaved pods; final state must reflect the last event per pod."""
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=4))
+        pool.start()
+        try:
+            pods = [f"pod-{i}" for i in range(8)]
+            for round_ in range(50):
+                for pod in pods:
+                    pool.add_task(Message("t", pod, MODEL, _stored_payload([round_])))
+                    if round_ % 2 == 0:
+                        pool.add_task(Message("t", pod, MODEL, _removed_payload([round_])))
+            assert pool.drain(timeout=10)
+            # odd rounds stored and never removed; even rounds removed last
+            for round_ in range(50):
+                got = index.lookup([Key(MODEL, round_)], set())
+                pods_found = set(got.get(Key(MODEL, round_), []))
+                if round_ % 2 == 0:
+                    assert pods_found == set(), f"round {round_}: {pods_found}"
+                else:
+                    assert pods_found == set(pods), f"round {round_}: {pods_found}"
+        finally:
+            pool.shutdown()
+
+
+class TestZMQEndToEnd:
+    """The offline-demo acceptance flow (reference §3.5): score empty →
+    publish BlockStored → score hits → publish BlockRemoved → score reduced."""
+
+    def test_offline_demo_flow(self):
+        from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import TokenProcessorConfig
+        from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
+
+        class CharTok(Tokenizer):
+            def encode(self, p, m):
+                return [ord(c) for c in p], [(i, i + 1) for i in range(len(p))]
+
+        port = 15571
+        indexer = KVCacheIndexer(
+            KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=4)),
+            tokenizer=CharTok(),
+        )
+        indexer.run()
+        pool = KVEventsPool(indexer.kv_block_index, KVEventsPoolConfig(concurrency=2))
+        pool.start()
+        sub = ZMQSubscriber(pool, ZMQSubscriberConfig(endpoint=f"tcp://*:{port}"))
+        sub.start()
+
+        prompt = "abcdefghijklmnop"  # 4 blocks of 4
+        keys = indexer.token_processor.tokens_to_kv_block_keys(
+            [ord(c) for c in prompt], MODEL
+        )
+        hashes = [k.chunk_hash for k in keys]
+
+        try:
+            pub = ZMQPublisher(
+                ZMQPublisherConfig(
+                    endpoint=f"tcp://localhost:{port}",
+                    pod_identifier="tpu-pod-1",
+                    model_name=MODEL,
+                )
+            )
+            # PUB/SUB needs the subscription to propagate; retry-publish until
+            # the subscriber sees it (slow-joiner handling).
+            assert indexer.get_pod_scores(prompt, MODEL) == {}
+
+            deadline = time.time() + 20
+            scores = {}
+            while time.time() < deadline and not scores:
+                pub.publish([BlockStored(block_hashes=hashes, token_ids=[], block_size=4)])
+                time.sleep(0.2)
+                scores = indexer.get_pod_scores(prompt, MODEL)
+            assert scores == {"tpu-pod-1": 4}
+
+            # Remove the last two blocks → score drops to 2.
+            pub.publish([BlockRemoved(block_hashes=hashes[2:])])
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                scores = indexer.get_pod_scores(prompt, MODEL)
+                if scores == {"tpu-pod-1": 2}:
+                    break
+                time.sleep(0.1)
+            assert scores == {"tpu-pod-1": 2}
+            pub.close()
+        finally:
+            sub.shutdown()
+            pool.shutdown()
+            indexer.shutdown()
