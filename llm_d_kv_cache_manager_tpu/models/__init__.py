@@ -1,0 +1,21 @@
+from .llama import (
+    LlamaConfig,
+    init_params,
+    prefill,
+    decode_step,
+    init_kv_pages,
+    LLAMA_3_8B,
+    LLAMA_3_70B,
+    TINY_LLAMA,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "prefill",
+    "decode_step",
+    "init_kv_pages",
+    "LLAMA_3_8B",
+    "LLAMA_3_70B",
+    "TINY_LLAMA",
+]

@@ -1,0 +1,95 @@
+"""HuggingFace → native parameter conversion for Llama-family checkpoints.
+
+Maps a transformers Llama/Qwen2 state dict onto the pytree layout of
+``models/llama.py``. torch ``Linear`` stores ``[out, in]`` and computes
+``x @ W.T``; our params store ``[in, out]``, so every projection transposes.
+The RoPE convention (half-split rotate) matches HF Llama, so no permutation
+of head channels is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig, Params
+
+
+def _to_np(t) -> np.ndarray:
+    """torch tensor / array-like → numpy (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def load_hf_state_dict(
+    state_dict: Mapping[str, Any], cfg: LlamaConfig
+) -> Params:
+    sd = state_dict
+
+    def get(name: str) -> np.ndarray:
+        return _to_np(sd[name])
+
+    def linear(name: str) -> jnp.ndarray:
+        return jnp.asarray(get(name).T, cfg.dtype)  # [out,in] -> [in,out]
+
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layer = {
+            "attn_norm": jnp.asarray(get(p + "input_layernorm.weight"), cfg.dtype),
+            "wq": linear(p + "self_attn.q_proj.weight"),
+            "wk": linear(p + "self_attn.k_proj.weight"),
+            "wv": linear(p + "self_attn.v_proj.weight"),
+            "wo": linear(p + "self_attn.o_proj.weight"),
+            "mlp_norm": jnp.asarray(get(p + "post_attention_layernorm.weight"), cfg.dtype),
+            "w_gate": linear(p + "mlp.gate_proj.weight"),
+            "w_up": linear(p + "mlp.up_proj.weight"),
+            "w_down": linear(p + "mlp.down_proj.weight"),
+        }
+        if cfg.qkv_bias:
+            layer["bq"] = jnp.asarray(get(p + "self_attn.q_proj.bias"), cfg.dtype)
+            layer["bk"] = jnp.asarray(get(p + "self_attn.k_proj.bias"), cfg.dtype)
+            layer["bv"] = jnp.asarray(get(p + "self_attn.v_proj.bias"), cfg.dtype)
+        layers.append(layer)
+
+    params: Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), cfg.dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), cfg.dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = linear("lm_head.weight")
+    return params
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """transformers LlamaConfig/Qwen2Config → native config."""
+    rope_scaling = None
+    rs = getattr(hf_config, "rope_scaling", None)
+    if rs and rs.get("rope_type", rs.get("type")) == "llama3":
+        from ..ops.rope import RopeScalingConfig
+
+        rope_scaling = RopeScalingConfig(
+            factor=rs["factor"],
+            low_freq_factor=rs["low_freq_factor"],
+            high_freq_factor=rs["high_freq_factor"],
+            original_max_position=rs["original_max_position_embeddings"],
+        )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads),
+        head_dim=getattr(hf_config, "head_dim", None),
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        rope_scaling=rope_scaling,
+        rms_norm_eps=hf_config.rms_norm_eps,
+        qkv_bias=getattr(hf_config, "attention_bias", False)
+        or hf_config.__class__.__name__.startswith("Qwen2"),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )

@@ -1,0 +1,13 @@
+from .rmsnorm import rms_norm
+from .rope import apply_rope, rope_frequencies
+from .attention import causal_prefill_attention
+from .paged_attention import paged_attention, paged_attention_reference
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "causal_prefill_attention",
+    "paged_attention",
+    "paged_attention_reference",
+]

@@ -1,0 +1,59 @@
+"""Prefill (causal) attention.
+
+Single fused einsum path that XLA tiles onto the MXU. The [s_q, s_k] score
+tensor is materialized, which is fine for the chunked-prefill sizes the
+engine schedules (it bounds chunk length); a Pallas flash-prefill kernel is
+the planned upgrade for long unchunked prefills. GQA is handled by reshaping
+query heads into (kv_head, group) blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_prefill_attention(
+    q: jnp.ndarray,  # [batch, seq, n_heads, head_dim]
+    k: jnp.ndarray,  # [batch, seq, n_kv_heads, head_dim]
+    v: jnp.ndarray,  # [batch, seq, n_kv_heads, head_dim]
+    *,
+    positions: Optional[jnp.ndarray] = None,  # [batch, seq] absolute positions
+    valid: Optional[jnp.ndarray] = None,  # [batch, seq] bool — False = padding
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal self-attention over one contiguous chunk (prefill).
+
+    When ``positions`` is given, the causal mask uses absolute positions so
+    chunked prefill (later chunks attending into earlier KV) composes; for
+    the single-chunk case the default arange mask applies. ``valid`` marks
+    padding positions whose keys must never be attended.
+    Returns [batch, seq, n_heads, head_dim].
+    """
+    b, s, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    if scale is None:
+        scale = d**-0.5
+
+    qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # [b, n_kv, group, s_q, s_k]
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf) * scale
+
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    mask = positions[:, None, None, :, None] >= positions[:, None, None, None, :]
+    if valid is not None:
+        mask = mask & valid[:, None, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    # A fully-masked query row (padding query) softmaxes to NaN; zero it.
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return out.reshape(b, s, n_q, d).astype(q.dtype)

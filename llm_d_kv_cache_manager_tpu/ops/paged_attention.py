@@ -1,0 +1,211 @@
+"""Paged decode attention: Pallas TPU kernel + reference implementation.
+
+The serving engine stores KV in fixed-size pages (blocks) scattered across a
+pool; at decode each sequence reads its pages via a block table. This is the
+hot op the reference ecosystem gets from vLLM's CUDA paged attention — here
+it is a TPU kernel designed for the hardware:
+
+- KV pool layout ``[n_kv_heads, total_pages, page_size, head_dim]``:
+  head-major so each (batch, kv_head) program streams contiguous
+  ``[page_size, head_dim]`` tiles (lane dim = head_dim = 128-friendly).
+- Grid ``(batch, n_kv_heads, max_pages)`` with the block table and sequence
+  lengths as scalar prefetch: the BlockSpec index_map dereferences the block
+  table so Pallas's pipeline DMAs exactly the pages each sequence owns —
+  gather without a gather op.
+- Online softmax (flash-style m/l/acc scratch carried across the page axis)
+  in float32; GQA handled by blocking query heads [group, head_dim] against
+  one KV head.
+
+CPU tests run the same kernel with ``interpret=True``;
+``paged_attention_reference`` is the numerics oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [batch, max_pages] int32
+    seq_lens_ref,  # [batch] int32
+    # blocks
+    q_ref,  # [1, 1, group, head_dim]
+    k_ref,  # [1, 1, page_size, head_dim]
+    v_ref,  # [1, 1, page_size, head_dim]
+    out_ref,  # [1, 1, group, head_dim]
+    # scratch
+    m_ref,  # [group, 128] f32
+    l_ref,  # [group, 128] f32
+    acc_ref,  # [group, head_dim] f32
+    *,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    seq_len = seq_lens_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Only pages holding tokens < seq_len contribute.
+    @pl.when(p * page_size < seq_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [group, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page_size, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [group, page_size]
+
+        # Mask slots at/after seq_len within this page.
+        token_idx = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=1
+        )
+        scores = jnp.where(token_idx < seq_len, scores, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [group, 1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [group, 1]
+        probs = jnp.exp(scores - m_new)  # [group, page_size]
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # len-0 seq → zeros, not NaN
+        out_ref[0, 0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "scale", "interpret"),
+)
+def paged_attention(
+    q: jnp.ndarray,  # [batch, n_heads, head_dim]
+    k_pages: jnp.ndarray,  # [n_kv_heads, total_pages, page_size, head_dim]
+    v_pages: jnp.ndarray,  # same
+    block_tables: jnp.ndarray,  # [batch, max_pages] int32; pad slots with 0
+    seq_lens: jnp.ndarray,  # [batch] int32
+    *,
+    page_size: Optional[int] = None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched single-token (decode) paged attention.
+
+    Returns [batch, n_heads, head_dim]. ``block_tables`` entries beyond a
+    sequence's page count must be valid page indices (e.g. 0); they are
+    masked out, never read into the result.
+    """
+    batch, n_heads, head_dim = q.shape
+    n_kv_heads, _total, ps, _hd = k_pages.shape
+    page_size = ps if page_size is None else page_size
+    if scale is None:
+        scale = head_dim**-0.5
+    group = n_heads // n_kv_heads
+    max_pages = block_tables.shape[1]
+
+    q_blocked = q.reshape(batch, n_kv_heads, group, head_dim)
+    block_tables = block_tables.astype(jnp.int32)
+    seq_lens = seq_lens.astype(jnp.int32)
+
+    grid = (batch, n_kv_heads, max_pages)
+
+    def q_index(b, h, p, bt, sl):
+        return (b, h, 0, 0)
+
+    def kv_index(b, h, p, bt, sl):
+        return (h, bt[b, p], 0, 0)
+
+    def out_index(b, h, p, bt, sl):
+        return (b, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, head_dim), q_index),
+            pl.BlockSpec((1, 1, page_size, head_dim), kv_index),
+            pl.BlockSpec((1, 1, page_size, head_dim), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, head_dim), out_index),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, head_dim), jnp.float32),
+        ],
+    )
+
+    kernel = functools.partial(_decode_kernel, page_size=page_size, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, n_kv_heads, group, head_dim), q.dtype),
+        interpret=interpret,
+    )(
+        block_tables,
+        seq_lens,
+        q_blocked,
+        k_pages,
+        v_pages,
+    )
+    return out.reshape(batch, n_heads, head_dim)
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    *,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Pure-jnp oracle: gather pages per sequence, mask, softmax."""
+    batch, n_heads, head_dim = q.shape
+    n_kv_heads, _, page_size, _ = k_pages.shape
+    group = n_heads // n_kv_heads
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = head_dim**-0.5
+
+    # Gather per-sequence K/V: [batch, n_kv, max_pages*page_size, d]
+    gathered_k = k_pages[:, block_tables]  # [n_kv, batch, max_pages, ps, d]
+    gathered_v = v_pages[:, block_tables]
+    gathered_k = jnp.moveaxis(gathered_k, 0, 1).reshape(
+        batch, n_kv_heads, max_pages * page_size, head_dim
+    )
+    gathered_v = jnp.moveaxis(gathered_v, 0, 1).reshape(
+        batch, n_kv_heads, max_pages * page_size, head_dim
+    )
+
+    qf = q.astype(jnp.float32).reshape(batch, n_kv_heads, group, head_dim)
+    scores = jnp.einsum("bhgd,bhtd->bhgt", qf, gathered_k.astype(jnp.float32)) * scale
+    token_idx = jnp.arange(max_pages * page_size)[None, None, None, :]
+    mask = token_idx < seq_lens[:, None, None, None]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # len-0 seqs
+    out = jnp.einsum("bhgt,bhtd->bhgd", probs, gathered_v.astype(jnp.float32))
+    return out.reshape(batch, n_heads, head_dim).astype(q.dtype)

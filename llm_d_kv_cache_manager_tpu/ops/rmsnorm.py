@@ -1,0 +1,24 @@
+"""RMSNorm.
+
+Plain jnp: XLA fuses the reduction + scale into neighboring ops on TPU; a
+hand-written Pallas kernel buys nothing here (HBM-bound elementwise work
+fuses into the surrounding matmuls).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Root-mean-square layer norm (Llama-style, no mean subtraction).
+
+    Statistics are computed in float32 regardless of input dtype (matches
+    reference implementations' numerics), output cast back to input dtype.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

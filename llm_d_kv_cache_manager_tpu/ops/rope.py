@@ -1,0 +1,68 @@
+"""Rotary position embeddings (Llama-style, half-split layout).
+
+Frequencies are precomputed once per model (host-side) and indexed by
+position inside jit — no data-dependent shapes. Supports Llama-3's
+frequency scaling (low/high-frequency band smoothing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)  # hashable: nested in jit-static LlamaConfig
+class RopeScalingConfig:
+    """Llama-3.1-style rope scaling parameters."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 500_000.0,
+    scaling: Optional[RopeScalingConfig] = None,
+) -> np.ndarray:
+    """Inverse frequencies [head_dim // 2], optionally llama-3.1-scaled."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling is not None:
+        low_wavelen = scaling.original_max_position / scaling.low_freq_factor
+        high_wavelen = scaling.original_max_position / scaling.high_freq_factor
+        wavelen = 2 * np.pi / inv_freq
+        scaled = np.where(wavelen > low_wavelen, inv_freq / scaling.factor, inv_freq)
+        smooth = (scaling.original_max_position / wavelen - scaling.low_freq_factor) / (
+            scaling.high_freq_factor - scaling.low_freq_factor
+        )
+        mid = (1 - smooth) * inv_freq / scaling.factor + smooth * inv_freq
+        is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+        scaled = np.where(is_mid, mid, scaled)
+        inv_freq = scaled
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., seq, n_heads, head_dim]
+    positions: jnp.ndarray,  # [..., seq]
+    inv_freq: jnp.ndarray,  # [head_dim // 2]
+) -> jnp.ndarray:
+    """Rotate q or k by position. Half-split convention (HF Llama): the
+    head dim is split as [d/2 | d/2] and rotated pairwise across halves.
+    Computation in float32, cast back to input dtype.
+    """
+    dtype = x.dtype
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq[None, :]  # [..., seq, d/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # [..., seq, 1, d/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    xf = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(dtype)

@@ -3,17 +3,28 @@
 Tests run on CPU with a virtual 8-device mesh so multi-chip sharding code is
 exercised without TPU hardware (the driver separately dry-runs the multichip
 path; bench.py runs on the one real chip).
+
+The container's ``sitecustomize`` imports jax and registers the axon
+TPU-tunnel PJRT plugin before conftest runs, with ``JAX_PLATFORMS=axon``
+baked into jax's config — so env vars set here are too late, and letting
+backend init reach the tunnel can hang every test run if the tunnel is
+wedged. ``jax.config.update`` before the first backend initialization pins
+the platform to CPU in-process and the tunnel is never touched.
 """
 
 import os
 import sys
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must precede the first jax backend initialization (not merely jax import).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,277 @@
+"""Model numerics tests.
+
+- Cross-check against transformers' torch Llama (random-init, no network):
+  the strongest validation of RMSNorm/RoPE/GQA/SwiGLU wiring.
+- Prefill↔decode consistency on the paged KV cache: prefilling n tokens
+  must give the same next-token logits as prefilling n-1 and decoding one.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_d_kv_cache_manager_tpu.models import (
+    TINY_LLAMA,
+    LlamaConfig,
+    decode_step,
+    init_kv_pages,
+    init_params,
+    prefill,
+)
+
+PAGE_SIZE = 4
+
+
+def _alloc(cfg, batch, max_tokens):
+    """Trivial sequential page allocation for tests."""
+    pages_per_seq = max_tokens // PAGE_SIZE
+    total = batch * pages_per_seq + 1
+    k_pages, v_pages = init_kv_pages(cfg, total, PAGE_SIZE)
+    block_tables = np.arange(batch * pages_per_seq).reshape(batch, pages_per_seq) + 1
+    return k_pages, v_pages, jnp.asarray(block_tables, jnp.int32)
+
+
+def _prefill_args(block_tables, batch, seq):
+    pos = np.tile(np.arange(seq), (batch, 1))
+    page_ids = np.take_along_axis(
+        np.asarray(block_tables), pos // PAGE_SIZE, axis=1
+    )
+    slot_ids = pos % PAGE_SIZE
+    valid = np.ones((batch, seq), bool)
+    return (
+        jnp.asarray(pos, jnp.int32),
+        jnp.asarray(valid),
+        jnp.asarray(page_ids, jnp.int32),
+        jnp.asarray(slot_ids, jnp.int32),
+    )
+
+
+class TestHFNumericsParity:
+    def test_logits_match_transformers(self):
+        torch = pytest.importorskip("torch")
+        from transformers import LlamaConfig as HFLlamaConfig
+        from transformers import LlamaForCausalLM
+
+        from llm_d_kv_cache_manager_tpu.models.hf_loader import (
+            config_from_hf,
+            load_hf_state_dict,
+        )
+
+        hf_cfg = HFLlamaConfig(
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            rope_theta=10000.0,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(0)
+        hf_model = LlamaForCausalLM(hf_cfg).eval()
+
+        cfg = config_from_hf(hf_cfg)
+        cfg = LlamaConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+        params = load_hf_state_dict(hf_model.state_dict(), cfg)
+
+        batch, seq = 2, 12
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 128, (batch, seq))
+
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()  # [b, s, vocab]
+
+        k_pages, v_pages, block_tables = _alloc(cfg, batch, seq)
+        pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+        logits, _, _ = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), hf_logits[:, -1], rtol=2e-4, atol=2e-4
+        )
+
+    def test_qwen_style_bias_loads(self):
+        torch = pytest.importorskip("torch")
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+
+        from llm_d_kv_cache_manager_tpu.models.hf_loader import (
+            config_from_hf,
+            load_hf_state_dict,
+        )
+
+        hf_cfg = Qwen2Config(
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            rope_theta=10000.0,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(1)
+        hf_model = Qwen2ForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg)
+        assert cfg.qkv_bias
+        cfg = LlamaConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+        params = load_hf_state_dict(hf_model.state_dict(), cfg)
+
+        batch, seq = 1, 8
+        rng = np.random.default_rng(2)
+        tokens = rng.integers(0, 128, (batch, seq))
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()
+
+        k_pages, v_pages, block_tables = _alloc(cfg, batch, seq)
+        pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+        logits, _, _ = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), hf_logits[:, -1], rtol=2e-4, atol=2e-4
+        )
+
+
+class TestPrefillDecodeConsistency:
+    def test_decode_matches_prefill(self):
+        cfg = TINY_LLAMA
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        batch, seq = 2, 12
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, cfg.vocab_size, (batch, seq))
+
+        # Full prefill of all `seq` tokens.
+        k_pages, v_pages, block_tables = _alloc(cfg, batch, seq)
+        pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+        full_logits, _, _ = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+
+        # Prefill seq-1, then decode token seq-1.
+        k_pages, v_pages, block_tables = _alloc(cfg, batch, seq)
+        pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+        valid = valid.at[:, -1].set(False)
+        _, k_pages, v_pages = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+        dec_logits, _, _ = decode_step(
+            params, cfg,
+            jnp.asarray(tokens[:, -1], jnp.int32),
+            jnp.full((batch,), seq - 1, jnp.int32),
+            k_pages, v_pages, block_tables,
+            jnp.full((batch,), seq, jnp.int32),
+            page_size=PAGE_SIZE, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(dec_logits), np.asarray(full_logits), rtol=2e-4, atol=2e-4
+        )
+
+    def test_decode_two_steps(self):
+        cfg = TINY_LLAMA
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        batch, seq = 1, 8
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, cfg.vocab_size, (batch, seq))
+
+        full_k, full_v, block_tables = _alloc(cfg, batch, seq)
+        pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+        full_logits, _, _ = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            full_k, full_v, page_ids, slot_ids,
+        )
+
+        # Prefill first 6, decode tokens 6 and 7.
+        k_pages, v_pages, block_tables = _alloc(cfg, batch, seq)
+        pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+        valid = valid.at[:, 6:].set(False)
+        _, k_pages, v_pages = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+        for step in (6, 7):
+            logits, k_pages, v_pages = decode_step(
+                params, cfg,
+                jnp.asarray(tokens[:, step], jnp.int32),
+                jnp.full((batch,), step, jnp.int32),
+                k_pages, v_pages, block_tables,
+                jnp.full((batch,), step + 1, jnp.int32),
+                page_size=PAGE_SIZE, interpret=True,
+            )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full_logits), rtol=2e-4, atol=2e-4
+        )
+
+    def test_pad_position_value_is_irrelevant(self):
+        # Invalid positions are fully masked: whatever position value padding
+        # carries (incl. 0, which passes the causal check) must not affect
+        # valid tokens' logits.
+        cfg = TINY_LLAMA
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(5)
+        tokens = rng.integers(0, cfg.vocab_size, (1, 8))
+
+        k_pages, v_pages, bt = _alloc(cfg, 1, 8)
+        pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 8)
+        ref_logits, _, _ = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+
+        padded = np.concatenate([tokens, rng.integers(0, cfg.vocab_size, (1, 4))], axis=1)
+        k_pages, v_pages, bt = _alloc(cfg, 1, 12)
+        pos12, valid12, page_ids12, slot_ids12 = _prefill_args(bt, 1, 12)
+        pos12 = pos12.at[:, 8:].set(0)  # pad positions = 0, the nasty case
+        valid12 = valid12.at[:, 8:].set(False)
+        pad_logits, _, _ = prefill(
+            params, cfg, jnp.asarray(padded, jnp.int32), pos12, valid12,
+            k_pages, v_pages, page_ids12, slot_ids12,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pad_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+        )
+
+    def test_llama31_rope_scaling_config_is_jittable(self):
+        from llm_d_kv_cache_manager_tpu.ops.rope import RopeScalingConfig
+
+        cfg = LlamaConfig(**{**TINY_LLAMA.__dict__, "rope_scaling": RopeScalingConfig()})
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        k_pages, v_pages, bt = _alloc(cfg, 1, 8)
+        pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 8)
+        logits, _, _ = prefill(
+            params, cfg, jnp.zeros((1, 8), jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+        assert logits.shape == (1, cfg.vocab_size)
+
+    def test_padded_prefill_matches_unpadded(self):
+        cfg = TINY_LLAMA
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(4)
+        tokens = rng.integers(0, cfg.vocab_size, (1, 8))
+
+        k_pages, v_pages, bt = _alloc(cfg, 1, 8)
+        pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 8)
+        ref_logits, _, _ = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+
+        # Same 8 tokens followed by 4 padding slots marked invalid.
+        padded = np.concatenate([tokens, np.zeros((1, 4), int)], axis=1)
+        k_pages, v_pages, bt = _alloc(cfg, 1, 12)
+        pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 12)
+        valid = valid.at[:, 8:].set(False)
+        pad_logits, _, _ = prefill(
+            params, cfg, jnp.asarray(padded, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pad_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+        )
