@@ -77,7 +77,14 @@ class EventBatch:
         arr = [self.ts, [e.to_tagged_union() for e in self.events]]
         if self.data_parallel_rank is not None:
             arr.append(self.data_parallel_rank)
-        return msgpack.packb(arr, use_bin_type=True)
+        return msgpack.packb(arr, use_bin_type=True, default=_coerce_numpy)
+
+
+def _coerce_numpy(obj):
+    """msgpack default hook: numpy scalars → python ints/floats."""
+    if hasattr(obj, "item"):
+        return obj.item()
+    raise TypeError(f"cannot serialize {type(obj)!r}")
 
 
 def _get(parts: Sequence, idx: int, default=None):

@@ -69,15 +69,25 @@ def config_from_hf(hf_config) -> LlamaConfig:
     """transformers LlamaConfig/Qwen2Config → native config."""
     rope_scaling = None
     rs = getattr(hf_config, "rope_scaling", None)
-    if rs and rs.get("rope_type", rs.get("type")) == "llama3":
-        from ..ops.rope import RopeScalingConfig
+    if rs:
+        rope_type = rs.get("rope_type", rs.get("type"))
+        if rope_type == "llama3":
+            from ..ops.rope import RopeScalingConfig
 
-        rope_scaling = RopeScalingConfig(
-            factor=rs["factor"],
-            low_freq_factor=rs["low_freq_factor"],
-            high_freq_factor=rs["high_freq_factor"],
-            original_max_position=rs["original_max_position_embeddings"],
-        )
+            rope_scaling = RopeScalingConfig(
+                factor=rs["factor"],
+                low_freq_factor=rs["low_freq_factor"],
+                high_freq_factor=rs["high_freq_factor"],
+                original_max_position=rs["original_max_position_embeddings"],
+            )
+        elif rope_type in (None, "default"):
+            pass
+        else:
+            # Silently loading e.g. linear/dynamic/yarn scaling with base
+            # frequencies would degrade long-context generation undetectably.
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} is not supported yet"
+            )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,

@@ -30,8 +30,8 @@ import numpy as np
 
 from ..ops import (
     apply_rope,
-    causal_prefill_attention,
     paged_attention,
+    prefill_with_paged_context,
     rms_norm,
     rope_frequencies,
 )
@@ -197,14 +197,16 @@ def prefill(
     v_pages: jnp.ndarray,
     page_ids: jnp.ndarray,  # [b, s] destination page per token
     slot_ids: jnp.ndarray,  # [b, s] destination slot per token
+    block_tables: jnp.ndarray,  # [b, max_ctx_pages] int32 — cached-context pages
+    ctx_lens: jnp.ndarray,  # [b] int32 — prefix-cached context length (0 = fresh)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Process a prompt chunk: returns (logits at last valid position per
     sequence [b, vocab], updated k_pages, v_pages).
 
-    Single-chunk prefill: all of a sequence's context is in this chunk
-    (chunked/continued prefill composes via the engine scheduling one
-    chunk per step with positions offset; attention here is causal within
-    the chunk).
+    The chunk attends causally within itself AND to ``ctx_lens`` tokens of
+    prefix-cached context already resident in the page pool — this is how a
+    prefix-cache hit skips recomputing the shared prefix. Fresh sequences
+    pass ``ctx_lens = 0``.
     """
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     h = params["embed"][tokens]  # [b, s, d]
@@ -217,7 +219,10 @@ def prefill(
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
 
-        attn = causal_prefill_attention(q, k, v, positions=positions, valid=valid)
+        attn = prefill_with_paged_context(
+            q, k, v, k_pages[li], v_pages[li], block_tables, ctx_lens,
+            positions=positions, valid=valid,
+        )
         b, s, _, _ = attn.shape
         h = h + attn.reshape(b, s, -1) @ layer["wo"]
 

@@ -1,6 +1,6 @@
 from .rmsnorm import rms_norm
 from .rope import apply_rope, rope_frequencies
-from .attention import causal_prefill_attention
+from .attention import causal_prefill_attention, prefill_with_paged_context
 from .paged_attention import paged_attention, paged_attention_reference
 
 __all__ = [
@@ -8,6 +8,7 @@ __all__ = [
     "apply_rope",
     "rope_frequencies",
     "causal_prefill_attention",
+    "prefill_with_paged_context",
     "paged_attention",
     "paged_attention_reference",
 ]

@@ -57,3 +57,66 @@ def causal_prefill_attention(
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
     return out.reshape(b, s, n_q, d).astype(q.dtype)
+
+
+def prefill_with_paged_context(
+    q: jnp.ndarray,  # [batch, seq, n_heads, head_dim] — the fresh chunk
+    k: jnp.ndarray,  # [batch, seq, n_kv_heads, head_dim]
+    v: jnp.ndarray,  # [batch, seq, n_kv_heads, head_dim]
+    k_pages: jnp.ndarray,  # [n_kv_heads, total_pages, page_size, head_dim]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [batch, max_ctx_pages] int32 (pad with 0)
+    ctx_lens: jnp.ndarray,  # [batch] int32 — tokens of cached context
+    *,
+    positions: jnp.ndarray,  # [batch, seq] absolute positions of the chunk
+    valid: Optional[jnp.ndarray] = None,  # [batch, seq] padding mask
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Chunked prefill attending to prefix-cached pages *and* causally within
+    the fresh chunk.
+
+    This is what turns a prefix-cache hit into skipped compute: the shared
+    prefix's K/V already live in the page pool (written by whichever request
+    computed them — RoPE is absolute so they are position-correct), and the
+    request only prefills its suffix. Context tokens all precede the chunk,
+    so cross-attention to them needs only the ctx_len mask, not a causal one.
+
+    One fused softmax over [context ++ chunk] keys. Returns
+    [batch, seq, n_heads, head_dim].
+    """
+    b, s, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    if scale is None:
+        scale = d**-0.5
+    max_ctx = block_tables.shape[1] * k_pages.shape[2]
+
+    qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
+
+    # Context keys/values gathered per sequence: [b, n_kv, max_ctx, d].
+    page_size = k_pages.shape[2]
+    ctx_k = jnp.moveaxis(k_pages[:, block_tables], 0, 1).reshape(b, n_kv, max_ctx, d)
+    ctx_v = jnp.moveaxis(v_pages[:, block_tables], 0, 1).reshape(b, n_kv, max_ctx, d)
+
+    ctx_scores = jnp.einsum("bqhgd,bhtd->bhgqt", qf, ctx_k.astype(jnp.float32)) * scale
+    ctx_mask = (
+        jnp.arange(max_ctx)[None, None, None, None, :] < ctx_lens[:, None, None, None, None]
+    )
+    ctx_scores = jnp.where(ctx_mask, ctx_scores, -jnp.inf)
+
+    chunk_scores = (
+        jnp.einsum("bqhgd,bkhd->bhgqk", qf, k.astype(jnp.float32)) * scale
+    )
+    chunk_mask = positions[:, None, None, :, None] >= positions[:, None, None, None, :]
+    if valid is not None:
+        chunk_mask = chunk_mask & valid[:, None, None, None, :]
+    chunk_scores = jnp.where(chunk_mask, chunk_scores, -jnp.inf)
+
+    scores = jnp.concatenate([ctx_scores, chunk_scores], axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+
+    out = jnp.einsum(
+        "bhgqt,bhtd->bqhgd", probs[..., :max_ctx], ctx_v.astype(jnp.float32)
+    ) + jnp.einsum("bhgqk,bkhd->bqhgd", probs[..., max_ctx:], v.astype(jnp.float32))
+    return out.reshape(b, s, n_q, d).astype(q.dtype)

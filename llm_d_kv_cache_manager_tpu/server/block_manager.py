@@ -1,0 +1,248 @@
+"""Paged KV block allocator with prefix caching and KV-event emission.
+
+The TPU-side counterpart of what vLLM's block manager does for the reference
+ecosystem, designed so the routing indexer can track this engine's cache:
+
+- Pages are fixed-size (``page_size`` tokens). Page 0 is reserved as the
+  padding target for block tables (the decode kernel requires valid ids in
+  padded slots) and never allocated.
+- **Prefix caching**: a page holding a *full* block of tokens is registered
+  under its chained sha256-CBOR block hash — computed by the same
+  ``ChunkedTokenDatabase`` the indexer uses, so engine-emitted event hashes
+  and indexer read-path hashes are identical by construction (the reference
+  needed deployment-time seed alignment instead,
+  ``token_processor.go:37-40``).
+- Cached pages are ref-counted; freed pages with a hash go to an LRU of
+  evictable pages and are only recycled (and their ``BlockRemoved`` emitted)
+  when the free pool runs dry.
+- Every transition emits KV events through ``on_events``:
+  ``BlockStored`` when a full page is registered, ``BlockRemoved`` when an
+  evictable page is recycled — the engine forwards them to the ZMQ
+  publisher (write path of SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence as Seq
+
+from ..kvcache.kvblock import ChunkedTokenDatabase, TokenProcessorConfig
+from ..kvcache.kvevents.events import BlockRemoved, BlockStored, Event
+from ..utils import get_logger
+from .sequence import Sequence
+
+log = get_logger("server.block_manager")
+
+
+class AllocationError(RuntimeError):
+    """Raised when the pool cannot satisfy an allocation even after evicting."""
+
+
+@dataclass
+class BlockManagerConfig:
+    total_pages: int = 1024
+    page_size: int = 16
+    hash_seed: str = ""
+    # Emit one BlockStored per batch of freshly-filled pages.
+    emit_events: bool = True
+
+
+@dataclass
+class _PageInfo:
+    ref_count: int = 0
+    chain_hash: Optional[int] = None
+    #: token ids of the full block (kept for BlockStored events)
+    token_ids: tuple[int, ...] = ()
+    parent_hash: Optional[int] = None
+
+
+class BlockManager:
+    def __init__(
+        self,
+        config: BlockManagerConfig,
+        on_events: Optional[Callable[[list[Event]], None]] = None,
+    ):
+        if config.total_pages < 2:
+            raise ValueError("total_pages must be >= 2 (page 0 is reserved)")
+        self.config = config
+        self.token_db = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size=config.page_size, hash_seed=config.hash_seed)
+        )
+        self.on_events = on_events
+        # page id -> info, for allocated pages only
+        self._pages: dict[int, _PageInfo] = {}
+        self._free: list[int] = list(range(config.total_pages - 1, 0, -1))  # pop() -> 1,2,..
+        # chain_hash -> page id (live cached pages, referenced or evictable)
+        self._cached: dict[int, int] = {}
+        # evictable cached pages (ref_count == 0), LRU order
+        self._evictable: OrderedDict[int, None] = OrderedDict()  # page ids
+        self._pending_events: list[Event] = []
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def num_cached_pages(self) -> int:
+        return len(self._cached)
+
+    # -- event plumbing -----------------------------------------------------
+    def _emit(self, ev: Event) -> None:
+        if self.config.emit_events:
+            self._pending_events.append(ev)
+
+    def flush_events(self) -> list[Event]:
+        """Drain pending events (engine calls once per step and publishes)."""
+        evs, self._pending_events = self._pending_events, []
+        if evs and self.on_events is not None:
+            self.on_events(evs)
+        return evs
+
+    # -- low-level page ops -------------------------------------------------
+    def _pop_free_page(self) -> int:
+        if self._free:
+            page = self._free.pop()
+            self._pages[page] = _PageInfo(ref_count=1)
+            return page
+        # Recycle the least-recently-used evictable cached page.
+        if self._evictable:
+            page, _ = self._evictable.popitem(last=False)
+            info = self._pages[page]
+            assert info.ref_count == 0 and info.chain_hash is not None
+            del self._cached[info.chain_hash]
+            self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="tpu_hbm"))
+            self._pages[page] = _PageInfo(ref_count=1)
+            return page
+        raise AllocationError("KV page pool exhausted")
+
+    def _incref(self, page: int) -> None:
+        info = self._pages[page]
+        if info.ref_count == 0:
+            self._evictable.pop(page, None)
+        info.ref_count += 1
+
+    def _decref(self, page: int) -> None:
+        info = self._pages[page]
+        info.ref_count -= 1
+        assert info.ref_count >= 0
+        if info.ref_count == 0:
+            if info.chain_hash is not None:
+                # Stays cached & evictable: warm for future prefix hits.
+                self._evictable[page] = None
+                self._evictable.move_to_end(page)
+            else:
+                del self._pages[page]
+                self._free.append(page)
+
+    # -- sequence lifecycle -------------------------------------------------
+    def allocate(self, seq: Sequence) -> int:
+        """Allocate pages for a sequence's prompt, reusing prefix-cached
+        pages. Sets ``seq.block_table`` / ``seq.num_cached_prompt``; returns
+        the number of prompt tokens served from cache."""
+        assert not seq.block_table, "sequence already allocated"
+        tokens = seq.prompt_tokens
+        ps = self.config.page_size
+        hashes = self.token_db.prefix_hashes(tokens)
+
+        block_table: list[int] = []
+        cached_tokens = 0
+        for h in hashes:
+            page = self._cached.get(h)
+            if page is None:
+                break
+            self._incref(page)
+            block_table.append(page)
+            cached_tokens += ps
+        # Never serve the *entire* prompt from cache: the engine needs at
+        # least one fresh position to produce first-token logits.
+        if cached_tokens >= len(tokens) and block_table:
+            page = block_table.pop()
+            self._decref(page)
+            cached_tokens -= ps
+
+        n_pages_needed = -(-len(tokens) // ps)
+        try:
+            while len(block_table) < n_pages_needed:
+                block_table.append(self._pop_free_page())
+        except AllocationError:
+            for page in block_table:
+                self._decref(page)
+            raise
+
+        seq.block_table = block_table
+        seq.num_cached_prompt = cached_tokens
+        seq.num_computed = cached_tokens
+        # Cache-hit pages are already registered; continue the hash chain
+        # from the last reused page.
+        n_reused = cached_tokens // ps
+        seq.num_registered_pages = n_reused
+        seq.last_chain_hash = (
+            self._pages[block_table[n_reused - 1]].chain_hash if n_reused else None
+        )
+        return cached_tokens
+
+    def can_allocate(self, seq: Sequence) -> bool:
+        # Conservative: ignores prefix-cache hits (which only reduce demand).
+        ps = self.config.page_size
+        return -(-len(seq.prompt_tokens) // ps) <= self.num_free
+
+    def append_slot(self, seq: Sequence) -> None:
+        """Ensure capacity for one more token during decode; allocates a new
+        page when the sequence crosses a page boundary."""
+        ps = self.config.page_size
+        if seq.num_tokens > len(seq.block_table) * ps:
+            seq.block_table.append(self._pop_free_page())
+
+    def register_full_pages(self, seq: Sequence) -> None:
+        """Hash + cache-register any newly-completed pages of ``seq`` and
+        queue their BlockStored events. Called after compute has written the
+        page contents. Incremental: only blocks completed since the last
+        call are hashed (the chain parent rides on the sequence), keeping
+        per-sequence total hashing O(tokens) rather than O(tokens²)."""
+        from ..kvcache.kvblock.token_processor import hash_block
+
+        ps = self.config.page_size
+        n_full = seq.num_computed // ps
+        if n_full <= seq.num_registered_pages:
+            return
+        tokens = seq.all_tokens
+        parent = (
+            seq.last_chain_hash
+            if seq.last_chain_hash is not None
+            else self.token_db.init_hash
+        )
+        for i in range(seq.num_registered_pages, n_full):
+            block = tuple(int(t) for t in tokens[i * ps : (i + 1) * ps])
+            h = hash_block(parent, block)
+            page = seq.block_table[i]
+            info = self._pages[page]
+            if info.chain_hash is None:
+                existing = self._cached.get(h)
+                if existing is not None and existing != page:
+                    # Another sequence registered this block concurrently;
+                    # keep ours unhashed (it frees normally).
+                    parent = h
+                    continue
+                info.chain_hash = h
+                info.token_ids = block
+                info.parent_hash = parent if i > 0 else None
+                self._cached[h] = page
+                self._emit(
+                    BlockStored(
+                        block_hashes=[h],
+                        parent_block_hash=info.parent_hash,
+                        token_ids=list(block),
+                        block_size=ps,
+                        medium="tpu_hbm",
+                    )
+                )
+            parent = h
+        seq.num_registered_pages = n_full
+        seq.last_chain_hash = parent
+
+    def free_sequence(self, seq: Sequence) -> None:
+        for page in seq.block_table:
+            self._decref(page)
+        seq.block_table = []

@@ -1,0 +1,295 @@
+"""The JAX paged-KV inference engine with continuous batching.
+
+This is the in-tree TPU serving engine the BASELINE north star calls for:
+the component the reference *drives externally* (vLLM pods) is a
+first-class part of this framework. Per step the engine either prefills a
+batch of admitted prompts (suffix-only on prefix-cache hits) or decodes one
+token for every running sequence via the Pallas paged-attention kernel,
+then publishes ``BlockStored``/``BlockRemoved`` events so the routing
+indexer tracks this replica's cache (SURVEY §3.2 write path).
+
+XLA discipline: all jitted entry points see bucketed static shapes
+(prefill length rounded up to a bucket, decode batch padded to a fixed
+lane count), so steady-state serving replays cached executables.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kvcache.kvevents.events import Event
+from ..models import llama
+from ..models.llama import LlamaConfig
+from ..utils import get_logger
+from .block_manager import BlockManager, BlockManagerConfig
+from .sampling import sample_tokens
+from .scheduler import Scheduler, SchedulerConfig
+from .sequence import SamplingParams, Sequence, SequenceStatus
+
+log = get_logger("server.engine")
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+@dataclass
+class EngineConfig:
+    model: LlamaConfig = field(default_factory=lambda: llama.TINY_LLAMA)
+    block_manager: BlockManagerConfig = field(default_factory=BlockManagerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    max_model_len: int = 2048
+    #: decode batch lanes (padded); also the max concurrent running seqs
+    decode_batch_size: int = 8
+    #: prefill length bucket granularity (shape-bucketing for jit caching)
+    prefill_bucket: int = 64
+    #: run Pallas kernels in interpreter mode (CPU tests)
+    interpret: bool = False
+    seed: int = 0
+
+
+class Engine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params=None,
+        on_events: Optional[Callable[[list[Event]], None]] = None,
+    ):
+        self.config = config
+        cfg = config.model
+        self.model_cfg = cfg
+        ps = config.block_manager.page_size
+        self.page_size = ps
+        self.max_pages_per_seq = -(-config.max_model_len // ps)
+
+        self.block_manager = BlockManager(config.block_manager, on_events=on_events)
+        import dataclasses
+
+        sched_cfg = dataclasses.replace(
+            config.scheduler,
+            max_running=min(config.scheduler.max_running, config.decode_batch_size),
+        )
+        self.scheduler = Scheduler(self.block_manager, sched_cfg)
+
+        if params is None:
+            params = llama.init_params(jax.random.PRNGKey(config.seed), cfg)
+        self.params = params
+        self.k_pages, self.v_pages = llama.init_kv_pages(
+            cfg, config.block_manager.total_pages, ps
+        )
+        self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
+        self.finished: list[Sequence] = []
+        self._step_count = 0
+
+    # -- public API ---------------------------------------------------------
+    def add_request(
+        self,
+        prompt_tokens: list[int],
+        sampling: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+    ) -> Sequence:
+        if len(prompt_tokens) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt_tokens) >= self.config.max_model_len:
+            raise ValueError("prompt exceeds max_model_len")
+        seq = Sequence(
+            prompt_tokens=list(prompt_tokens),
+            sampling=sampling or SamplingParams(),
+            request_id=request_id,
+        )
+        self.scheduler.add(seq)
+        return seq
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def step(self) -> list[Sequence]:
+        """One engine iteration. Returns sequences finished this step."""
+        out = self.scheduler.schedule()
+        if out.prefill:
+            self._run_prefill(out.prefill)
+            self.scheduler.on_prefill_done(out.prefill)
+        elif out.decode:
+            self._run_decode(out.decode)
+
+        newly_finished = []
+        for seq in list(self.scheduler.running):
+            if self._should_finish(seq):
+                seq.finish_time = time.monotonic()
+                self.scheduler.on_finished(seq)
+                self.finished.append(seq)
+                newly_finished.append(seq)
+
+        self.block_manager.flush_events()
+        self._step_count += 1
+        return newly_finished
+
+    def run_until_complete(self, max_steps: int = 100_000) -> list[Sequence]:
+        done: list[Sequence] = []
+        for _ in range(max_steps):
+            if not self.has_work:
+                break
+            done.extend(self.step())
+        return done
+
+    # -- internals ----------------------------------------------------------
+    def _should_finish(self, seq: Sequence) -> bool:
+        if seq.num_generated == 0:
+            return False
+        if seq.num_generated >= seq.sampling.max_new_tokens:
+            return True
+        if seq.all_tokens[-1] in seq.sampling.stop_token_ids:
+            return True
+        return seq.num_tokens >= self.config.max_model_len
+
+    def _run_prefill(self, seqs: list[Sequence]) -> None:
+        ps = self.page_size
+        # Static shapes for jit-cache stability: batch padded to the
+        # configured prefill width, chunk length and context pages bucketed.
+        suffix_lens = [len(s.prompt_tokens) - s.num_cached_prompt for s in seqs]
+        chunk = _round_up(max(suffix_lens), self.config.prefill_bucket)
+        b = self.config.scheduler.max_prefill_batch
+
+        tokens = np.zeros((b, chunk), np.int32)
+        positions = np.zeros((b, chunk), np.int32)
+        valid = np.zeros((b, chunk), bool)
+        page_ids = np.zeros((b, chunk), np.int32)
+        slot_ids = np.zeros((b, chunk), np.int32)
+        max_ctx = max(s.num_cached_prompt // ps for s in seqs)
+        ctx_pages = max(4, _round_up(max_ctx, 4))
+        ctx_bt = np.zeros((b, ctx_pages), np.int32)
+        ctx_lens = np.zeros((b,), np.int32)
+
+        for i, seq in enumerate(seqs):
+            start = seq.num_cached_prompt
+            n = len(seq.prompt_tokens) - start
+            tokens[i, :n] = seq.prompt_tokens[start:]
+            pos = np.arange(start, start + n)
+            positions[i, :n] = pos
+            valid[i, :n] = True
+            page_ids[i, :n] = np.asarray(seq.block_table, np.int32)[pos // ps]
+            slot_ids[i, :n] = pos % ps
+            n_ctx_pages = start // ps
+            ctx_bt[i, :n_ctx_pages] = seq.block_table[:n_ctx_pages]
+            ctx_lens[i] = start
+
+        logits, self.k_pages, self.v_pages = llama.prefill(
+            self.params,
+            self.model_cfg,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(valid),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(page_ids),
+            jnp.asarray(slot_ids),
+            jnp.asarray(ctx_bt),
+            jnp.asarray(ctx_lens),
+        )
+        first_tokens = self._sample(logits, seqs)
+        now = time.monotonic()
+        for seq, tok in zip(seqs, first_tokens):
+            if not seq.block_table:
+                continue  # preempted by an earlier seq in this very batch
+            seq.num_computed = len(seq.prompt_tokens)
+            seq.output_tokens.append(int(tok))
+            seq.num_generated += 1
+            if seq.first_token_time is None:
+                seq.first_token_time = now
+            self._append_slot_or_preempt(seq)
+            self.block_manager.register_full_pages(seq)
+
+    def _run_decode(self, seqs: list[Sequence]) -> None:
+        lanes = self.config.decode_batch_size
+        assert len(seqs) <= lanes
+        tokens = np.zeros((lanes,), np.int32)
+        positions = np.zeros((lanes,), np.int32)
+        seq_lens = np.zeros((lanes,), np.int32)  # 0 = inactive lane
+        block_tables = np.zeros((lanes, self.max_pages_per_seq), np.int32)
+
+        for i, seq in enumerate(seqs):
+            tokens[i] = seq.all_tokens[-1]
+            positions[i] = seq.num_tokens - 1
+            seq_lens[i] = seq.num_tokens
+            bt = seq.block_table
+            block_tables[i, : len(bt)] = bt
+
+        logits, self.k_pages, self.v_pages = llama.decode_step(
+            self.params,
+            self.model_cfg,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(block_tables),
+            jnp.asarray(seq_lens),
+            page_size=self.page_size,
+            interpret=self.config.interpret,
+        )
+        # Sample over the full padded lane count (stable jit shape), then
+        # keep the active lanes.
+        sampled = self._sample(logits, seqs)[: len(seqs)]
+        for seq, tok in zip(seqs, sampled):
+            if not seq.block_table:
+                continue  # preempted by an earlier seq in this very batch
+            seq.num_computed = seq.num_tokens
+            seq.output_tokens.append(int(tok))
+            seq.num_generated += 1
+            self._append_slot_or_preempt(seq)
+            self.block_manager.register_full_pages(seq)
+
+    def _append_slot_or_preempt(self, seq: Sequence) -> None:
+        """Grow ``seq`` by one slot; on pool exhaustion, preempt the most
+        recently admitted *other* running sequence (recompute-style: its
+        pages are freed — surviving cached pages make its later re-prefill
+        cheap — and it requeues). Raises only when ``seq`` is alone and the
+        pool still cannot grow (pool smaller than one sequence)."""
+        from .block_manager import AllocationError
+
+        while True:
+            try:
+                self.block_manager.append_slot(seq)
+                return
+            except AllocationError:
+                victim = None
+                for cand in reversed(self.scheduler.running):
+                    if cand is not seq and not cand.is_finished():
+                        victim = cand
+                        break
+                if victim is None:
+                    raise
+                log.warning(
+                    "preempting sequence for pages",
+                    victim=victim.seq_id,
+                    for_seq=seq.seq_id,
+                )
+                self.scheduler.running.remove(victim)
+                self.block_manager.free_sequence(victim)
+                victim.fold_for_preemption()
+                self.scheduler.waiting.appendleft(victim)
+
+    def _sample(self, logits: jnp.ndarray, seqs: list[Sequence]) -> np.ndarray:
+        b = logits.shape[0]
+        temperature = np.zeros((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+        top_p = np.ones((b,), np.float32)
+        for i, seq in enumerate(seqs[:b]):
+            temperature[i] = seq.sampling.temperature
+            top_k[i] = seq.sampling.top_k
+            top_p[i] = seq.sampling.top_p
+        self._rng, key = jax.random.split(self._rng)
+        out = sample_tokens(
+            logits.astype(jnp.float32),
+            jnp.asarray(temperature),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            key,
+        )
+        return np.asarray(out)

@@ -1,0 +1,97 @@
+"""Request scheduler: continuous batching with FCFS admission.
+
+Each engine step is either a **prefill step** (admit waiting sequences whose
+pages fit, batched with padding) or a **decode step** (all running
+sequences, one token each). Prefill-priority keeps TTFT low, matching how
+the reference's benchmarked engines schedule (prefill preemption);
+page-budget admission prevents over-commit, and the page pool's LRU
+recycling provides the back-pressure.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from ..utils import get_logger
+from .block_manager import AllocationError, BlockManager
+from .sequence import Sequence, SequenceStatus
+
+log = get_logger("server.scheduler")
+
+
+@dataclass
+class SchedulerConfig:
+    max_running: int = 64
+    max_prefill_batch: int = 8
+    #: cap on tokens in one prefill batch (bounds score-matrix memory)
+    max_prefill_tokens: int = 8192
+
+
+@dataclass
+class ScheduleOutput:
+    prefill: list[Sequence]
+    decode: list[Sequence]
+
+
+class Scheduler:
+    def __init__(self, block_manager: BlockManager, config: Optional[SchedulerConfig] = None):
+        self.config = config or SchedulerConfig()
+        self.block_manager = block_manager
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+
+    def add(self, seq: Sequence) -> None:
+        seq.status = SequenceStatus.WAITING
+        self.waiting.append(seq)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def schedule(self) -> ScheduleOutput:
+        """Pick the work for one engine step."""
+        # Admit waiting sequences first (prefill priority).
+        prefill: list[Sequence] = []
+        budget = self.config.max_prefill_tokens
+        while (
+            self.waiting
+            and len(prefill) < self.config.max_prefill_batch
+            and len(self.running) + len(prefill) < self.config.max_running
+        ):
+            seq = self.waiting[0]
+            if not self.block_manager.can_allocate(seq):
+                break  # FCFS: wait for pages rather than starving this seq
+            try:
+                self.block_manager.allocate(seq)
+            except AllocationError:
+                break
+            # The token budget bounds prefill *compute*, which is only the
+            # non-cached suffix — known exactly after allocation resolves
+            # the prefix-cache hit. Roll back rather than over-commit.
+            suffix = max(len(seq.prompt_tokens) - seq.num_cached_prompt, 1)
+            if prefill and suffix > budget:
+                self.block_manager.free_sequence(seq)
+                seq.num_cached_prompt = 0
+                seq.num_computed = 0
+                seq.num_registered_pages = 0
+                seq.last_chain_hash = None
+                break
+            self.waiting.popleft()
+            budget -= suffix
+            prefill.append(seq)
+
+        if prefill:
+            return ScheduleOutput(prefill=prefill, decode=[])
+        return ScheduleOutput(prefill=[], decode=list(self.running))
+
+    def on_prefill_done(self, seqs: list[Sequence]) -> None:
+        for seq in seqs:
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+
+    def on_finished(self, seq: Sequence) -> None:
+        seq.status = SequenceStatus.FINISHED
+        self.running.remove(seq)
+        self.block_manager.free_sequence(seq)

@@ -1,0 +1,91 @@
+"""Request/sequence state for the serving engine."""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+_id_counter = itertools.count()
+
+
+class SequenceStatus(Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class SamplingParams:
+    max_new_tokens: int = 64
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0
+    stop_token_ids: tuple[int, ...] = ()
+
+
+@dataclass
+class Sequence:
+    prompt_tokens: list[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    seq_id: int = field(default_factory=lambda: next(_id_counter))
+    request_id: Optional[str] = None
+
+    # engine-managed state
+    status: SequenceStatus = SequenceStatus.WAITING
+    output_tokens: list[int] = field(default_factory=list)
+    block_table: list[int] = field(default_factory=list)
+    #: tokens whose K/V are resident in pages (cached prefix + processed)
+    num_computed: int = 0
+    #: tokens of the prompt served from the prefix cache
+    num_cached_prompt: int = 0
+    #: total generated tokens — survives preemption (output_tokens may be
+    #: folded into prompt_tokens when a sequence is preempted and recomputed)
+    num_generated: int = 0
+    #: length of the user's original prompt, for reporting after preemption
+    user_prompt_len: int = -1
+    #: prefix-cache registration bookkeeping (incremental hashing)
+    num_registered_pages: int = 0
+    last_chain_hash: Optional[int] = None
+    arrival_time: float = field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    def __post_init__(self):
+        if self.user_prompt_len < 0:
+            self.user_prompt_len = len(self.prompt_tokens)
+
+    @property
+    def all_tokens(self) -> list[int]:
+        return self.prompt_tokens + self.output_tokens
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_tokens) + len(self.output_tokens)
+
+    @property
+    def generated_tokens(self) -> list[int]:
+        """User-visible output, stable across preemption."""
+        return self.all_tokens[self.user_prompt_len :]
+
+    def fold_for_preemption(self) -> None:
+        """Recompute-preemption: all tokens become the new 'prompt'; the
+        re-prefill will cache-hit the pages that survived eviction."""
+        self.prompt_tokens = self.all_tokens
+        self.output_tokens = []
+        self.num_computed = 0
+        self.num_cached_prompt = 0
+        self.num_registered_pages = 0
+        self.last_chain_hash = None
+        self.status = SequenceStatus.WAITING
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    def is_finished(self) -> bool:
+        return self.status == SequenceStatus.FINISHED

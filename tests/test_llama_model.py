@@ -47,6 +47,10 @@ def _prefill_args(block_tables, batch, seq):
     )
 
 
+def _zero_ctx(batch):
+    return jnp.zeros((batch, 1), jnp.int32), jnp.zeros((batch,), jnp.int32)
+
+
 class TestHFNumericsParity:
     def test_logits_match_transformers(self):
         torch = pytest.importorskip("torch")
@@ -87,7 +91,7 @@ class TestHFNumericsParity:
         pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
         logits, _, _ = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
         np.testing.assert_allclose(
             np.asarray(logits), hf_logits[:, -1], rtol=2e-4, atol=2e-4
@@ -130,7 +134,7 @@ class TestHFNumericsParity:
         pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
         logits, _, _ = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
         np.testing.assert_allclose(
             np.asarray(logits), hf_logits[:, -1], rtol=2e-4, atol=2e-4
@@ -150,7 +154,7 @@ class TestPrefillDecodeConsistency:
         pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
         full_logits, _, _ = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
 
         # Prefill seq-1, then decode token seq-1.
@@ -159,7 +163,7 @@ class TestPrefillDecodeConsistency:
         valid = valid.at[:, -1].set(False)
         _, k_pages, v_pages = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
         dec_logits, _, _ = decode_step(
             params, cfg,
@@ -184,7 +188,7 @@ class TestPrefillDecodeConsistency:
         pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
         full_logits, _, _ = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            full_k, full_v, page_ids, slot_ids,
+            full_k, full_v, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
 
         # Prefill first 6, decode tokens 6 and 7.
@@ -193,7 +197,7 @@ class TestPrefillDecodeConsistency:
         valid = valid.at[:, 6:].set(False)
         _, k_pages, v_pages = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
         for step in (6, 7):
             logits, k_pages, v_pages = decode_step(
@@ -206,6 +210,47 @@ class TestPrefillDecodeConsistency:
             )
         np.testing.assert_allclose(
             np.asarray(logits), np.asarray(full_logits), rtol=2e-4, atol=2e-4
+        )
+
+    def test_prefix_cached_suffix_prefill_matches_full(self):
+        """The prefix-cache compute-skip: prefill tokens[0:8] (request A),
+        then prefill only tokens[8:12] with A's pages as context (request B
+        sharing the prefix) — logits must match a full 12-token prefill."""
+        cfg = TINY_LLAMA
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(6)
+        tokens = rng.integers(0, cfg.vocab_size, (1, 12))
+
+        # Oracle: full prefill.
+        k_pages, v_pages, bt = _alloc(cfg, 1, 12)
+        pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 12)
+        ref_logits, _, _ = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(1),
+        )
+
+        # Request A: prefill the 8-token shared prefix (2 pages).
+        k_pages, v_pages, bt = _alloc(cfg, 1, 12)
+        pos8, valid8, page_ids8, slot_ids8 = _prefill_args(bt[:, :2], 1, 8)
+        _, k_pages, v_pages = prefill(
+            params, cfg, jnp.asarray(tokens[:, :8], jnp.int32), pos8, valid8,
+            k_pages, v_pages, page_ids8, slot_ids8, *_zero_ctx(1),
+        )
+
+        # Request B: suffix-only prefill attending to A's cached pages.
+        suffix = jnp.asarray(tokens[:, 8:], jnp.int32)
+        pos_s = jnp.arange(8, 12, dtype=jnp.int32)[None, :]
+        valid_s = jnp.ones((1, 4), bool)
+        page_ids_s = jnp.full((1, 4), int(bt[0, 2]), jnp.int32)
+        slot_ids_s = pos_s % PAGE_SIZE
+        ctx_bt = bt[:, :2]
+        ctx_lens = jnp.asarray([8], jnp.int32)
+        logits, _, _ = prefill(
+            params, cfg, suffix, pos_s, valid_s,
+            k_pages, v_pages, page_ids_s, slot_ids_s, ctx_bt, ctx_lens,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
         )
 
     def test_pad_position_value_is_irrelevant(self):
@@ -221,7 +266,7 @@ class TestPrefillDecodeConsistency:
         pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 8)
         ref_logits, _, _ = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
 
         padded = np.concatenate([tokens, rng.integers(0, cfg.vocab_size, (1, 4))], axis=1)
@@ -231,7 +276,7 @@ class TestPrefillDecodeConsistency:
         valid12 = valid12.at[:, 8:].set(False)
         pad_logits, _, _ = prefill(
             params, cfg, jnp.asarray(padded, jnp.int32), pos12, valid12,
-            k_pages, v_pages, page_ids12, slot_ids12,
+            k_pages, v_pages, page_ids12, slot_ids12, *_zero_ctx(1),
         )
         np.testing.assert_allclose(
             np.asarray(pad_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
@@ -246,7 +291,7 @@ class TestPrefillDecodeConsistency:
         pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 8)
         logits, _, _ = prefill(
             params, cfg, jnp.zeros((1, 8), jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
         assert logits.shape == (1, cfg.vocab_size)
 
@@ -260,7 +305,7 @@ class TestPrefillDecodeConsistency:
         pos, valid, page_ids, slot_ids = _prefill_args(bt, 1, 8)
         ref_logits, _, _ = prefill(
             params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
 
         # Same 8 tokens followed by 4 padding slots marked invalid.
@@ -270,7 +315,7 @@ class TestPrefillDecodeConsistency:
         valid = valid.at[:, 8:].set(False)
         pad_logits, _, _ = prefill(
             params, cfg, jnp.asarray(padded, jnp.int32), pos, valid,
-            k_pages, v_pages, page_ids, slot_ids,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
         )
         np.testing.assert_allclose(
             np.asarray(pad_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
