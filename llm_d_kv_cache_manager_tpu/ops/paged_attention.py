@@ -123,6 +123,10 @@ def paged_attention(
     page_size = ps if page_size is None else page_size
     if scale is None:
         scale = head_dim**-0.5
+    if not interpret and jax.default_backend() == "cpu":
+        # Mosaic-compiled kernels need a TPU; CPU (tests, dry-runs) falls
+        # back to the interpreter transparently.
+        interpret = True
     group = n_heads // n_kv_heads
     max_pages = block_tables.shape[1]
 

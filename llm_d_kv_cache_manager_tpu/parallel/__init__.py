@@ -1,0 +1,14 @@
+from .mesh import make_mesh, MeshConfig
+from .sharding import param_shardings, batch_sharding, shard_params
+from .train import train_step, make_train_state, loss_fn
+
+__all__ = [
+    "make_mesh",
+    "MeshConfig",
+    "param_shardings",
+    "batch_sharding",
+    "shard_params",
+    "train_step",
+    "make_train_state",
+    "loss_fn",
+]

@@ -1,0 +1,81 @@
+"""Sharding rules: Megatron-style TP layout expressed as PartitionSpecs.
+
+GSPMD does the collective insertion; these specs only say where tensors
+live. Layout per transformer block (scaling-book recipe):
+
+- ``wq/wk/wv``           column-parallel  → shard output dim on ``tp``
+- ``wo``                 row-parallel     → shard input dim on ``tp``
+  (XLA emits the reduce-scatter/all-reduce after the contraction)
+- ``w_gate/w_up``        column-parallel
+- ``w_down``             row-parallel
+- norms/biases           replicated (biases of column-parallel layers are
+  sharded with their outputs)
+- ``embed``/``lm_head``  shard the vocab/output dim
+- KV pages               shard ``n_kv_heads`` on ``tp`` (head-parallel
+  cache; requires n_kv_heads % tp == 0)
+
+Batch dims shard on ``dp``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, Params
+
+
+def _layer_specs(cfg: LlamaConfig) -> dict[str, P]:
+    specs = {
+        "attn_norm": P(),
+        "wq": P(None, "tp"),
+        "wk": P(None, "tp"),
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "mlp_norm": P(),
+        "w_gate": P(None, "tp"),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    if cfg.qkv_bias:
+        specs["bq"] = P("tp")
+        specs["bk"] = P("tp")
+        specs["bv"] = P("tp")
+    return specs
+
+
+def param_specs(cfg: LlamaConfig) -> dict[str, Any]:
+    """PartitionSpec pytree matching ``init_params``' structure."""
+    specs: dict[str, Any] = {
+        "embed": P("tp", None),  # vocab-sharded; gather rides ICI
+        "final_norm": P(),
+        "layers": [_layer_specs(cfg) for _ in range(cfg.n_layers)],
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def param_shardings(mesh: Mesh, cfg: LlamaConfig):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: LlamaConfig) -> Params:
+    """Place a (host or single-device) param pytree onto the mesh."""
+    return jax.device_put(params, param_shardings(mesh, cfg))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Token batches: shard the leading batch dim on dp, replicate across tp."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def kv_pages_sharding(mesh: Mesh) -> NamedSharding:
+    """KV pools [n_layers, n_kv_heads, pages, page_size, hd]: head-parallel."""
+    return NamedSharding(mesh, P(None, "tp"))

@@ -1,0 +1,234 @@
+"""The online scoring service: HTTP API + full wiring.
+
+Parity with the reference's shipped binary (``examples/kv_events/online/
+main.go``): starts the KV-cache indexer, the event-ingestion pool with its
+ZMQ subscriber, optional metrics, and serves:
+
+- ``POST /score_completions``       {"prompt": str, "model": str,
+                                     "pod_identifiers": [str]?}
+- ``POST /score_chat_completions``  {"messages": [...], "model": str,
+                                     "chat_template": str?, ...}
+  (fetches + renders the model's chat template, then scores the flattened
+  prompt — reference ``online/main.go:273-339``)
+- ``GET  /metrics``                 Prometheus exposition
+- ``GET  /healthz``
+
+Configuration comes from env vars matching the reference's
+(``online/main.go:162-209``): HF_TOKEN, BLOCK_SIZE, PYTHONHASHSEED,
+ZMQ_ENDPOINT, ZMQ_TOPIC, POOL_CONCURRENCY, HTTP_PORT.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from aiohttp import web
+
+from ..kvcache import KVCacheIndexer, KVCacheIndexerConfig
+from ..kvcache.kvblock import TokenProcessorConfig
+from ..kvcache.kvevents import (
+    KVEventsPool,
+    KVEventsPoolConfig,
+    ZMQSubscriber,
+    ZMQSubscriberConfig,
+)
+from ..preprocessing import ChatTemplatingProcessor, FetchTemplateRequest, RenderRequest
+from ..tokenization import HFTokenizerConfig, TokenizationPoolConfig
+from ..utils import get_logger
+
+log = get_logger("server.api")
+
+
+@dataclass
+class ServiceConfig:
+    http_port: int = 8080
+    zmq_endpoint: str = "tcp://*:5557"
+    zmq_topic: str = "kv@"
+    pool_concurrency: int = 4
+    block_size: int = 16
+    hash_seed: str = ""
+    hf_token: Optional[str] = None
+    enable_metrics: bool = True
+    metrics_logging_interval: float = 0.0
+
+    @classmethod
+    def from_env(cls) -> "ServiceConfig":
+        env = os.environ
+        return cls(
+            http_port=int(env.get("HTTP_PORT", "8080")),
+            zmq_endpoint=env.get("ZMQ_ENDPOINT", "tcp://*:5557"),
+            zmq_topic=env.get("ZMQ_TOPIC", "kv@"),
+            pool_concurrency=int(env.get("POOL_CONCURRENCY", "4")),
+            block_size=int(env.get("BLOCK_SIZE", "16")),
+            hash_seed=env.get("PYTHONHASHSEED", ""),
+            hf_token=env.get("HF_TOKEN") or None,
+            enable_metrics=env.get("ENABLE_METRICS", "true").lower() != "false",
+            metrics_logging_interval=float(env.get("METRICS_LOGGING_INTERVAL", "0")),
+        )
+
+
+class ScoringService:
+    """Owns the indexer + event plane and exposes the HTTP handlers."""
+
+    def __init__(self, config: Optional[ServiceConfig] = None, *, tokenizer=None):
+        self.config = config or ServiceConfig()
+        cfg = self.config
+
+        from ..kvcache.kvblock import IndexConfig
+
+        self.indexer = KVCacheIndexer(
+            KVCacheIndexerConfig(
+                token_processor=TokenProcessorConfig(
+                    block_size=cfg.block_size, hash_seed=cfg.hash_seed
+                ),
+                index=IndexConfig(
+                    enable_metrics=cfg.enable_metrics,
+                    metrics_logging_interval=cfg.metrics_logging_interval,
+                ),
+                tokenization_pool=TokenizationPoolConfig(
+                    hf_tokenizer=HFTokenizerConfig(huggingface_token=cfg.hf_token)
+                ),
+            ),
+            tokenizer=tokenizer,
+        )
+        self.events_pool = KVEventsPool(
+            self.indexer.kv_block_index,
+            KVEventsPoolConfig(concurrency=cfg.pool_concurrency),
+        )
+        self.subscriber = ZMQSubscriber(
+            self.events_pool,
+            ZMQSubscriberConfig(endpoint=cfg.zmq_endpoint, topic_filter=cfg.zmq_topic),
+        )
+        self.chat = ChatTemplatingProcessor()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.chat.initialize()
+        self.indexer.run()
+        self.events_pool.start()
+        self.subscriber.start()
+        log.info(
+            "scoring service started",
+            zmq=self.config.zmq_endpoint,
+            block_size=self.config.block_size,
+        )
+
+    def shutdown(self) -> None:
+        self.subscriber.shutdown()
+        self.events_pool.shutdown()
+        self.indexer.shutdown()
+        self.chat.finalize()
+
+    # -- handlers -----------------------------------------------------------
+    async def handle_score_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        prompt = body.get("prompt")
+        model = body.get("model")
+        if not isinstance(prompt, str) or not isinstance(model, str) or not model:
+            return web.json_response(
+                {"error": "fields 'prompt' (str) and 'model' (str) are required"},
+                status=400,
+            )
+        pods = body.get("pod_identifiers") or []
+        loop = asyncio.get_running_loop()
+        try:
+            scores = await loop.run_in_executor(
+                None, self.indexer.get_pod_scores, prompt, model, pods
+            )
+        except Exception as exc:
+            log.exception("scoring failed")
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response({"scores": scores})
+
+    async def handle_score_chat_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        messages = body.get("messages")
+        model = body.get("model")
+        if not isinstance(messages, list) or not messages or not model:
+            return web.json_response(
+                {"error": "fields 'messages' (list) and 'model' (str) are required"},
+                status=400,
+            )
+        loop = asyncio.get_running_loop()
+
+        def render_and_score():
+            template, template_vars = self.chat.fetch_chat_template(
+                FetchTemplateRequest(
+                    model=model,
+                    chat_template=body.get("chat_template"),
+                    token=self.config.hf_token,
+                )
+            )
+            rendered = self.chat.render_chat_template(
+                RenderRequest(
+                    conversations=[messages],
+                    chat_template=template,
+                    tools=body.get("tools"),
+                    add_generation_prompt=body.get("add_generation_prompt", True),
+                    continue_final_message=body.get("continue_final_message", False),
+                    template_vars=template_vars,
+                )
+            )
+            prompt = rendered.rendered_chats[0]
+            scores = self.indexer.get_pod_scores(
+                prompt, model, body.get("pod_identifiers") or []
+            )
+            return prompt, scores
+
+        try:
+            prompt, scores = await loop.run_in_executor(None, render_and_score)
+        except Exception as exc:
+            log.exception("chat scoring failed")
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response({"scores": scores, "rendered_prompt_chars": len(prompt)})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        try:
+            import prometheus_client
+
+            data = prometheus_client.generate_latest()
+            return web.Response(
+                body=data, content_type="text/plain", charset="utf-8"
+            )
+        except ImportError:
+            from ..kvcache.metrics import collector
+
+            return web.json_response(collector.snapshot())
+
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/score_completions", self.handle_score_completions)
+        app.router.add_post("/score_chat_completions", self.handle_score_chat_completions)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/healthz", self.handle_healthz)
+        return app
+
+
+def main() -> None:
+    config = ServiceConfig.from_env()
+    service = ScoringService(config)
+    service.start()
+    app = service.build_app()
+
+    async def on_shutdown(_app):
+        service.shutdown()
+
+    app.on_shutdown.append(on_shutdown)
+    web.run_app(app, port=config.http_port)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,14 @@ class Engine:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.config.max_model_len:
             raise ValueError("prompt exceeds max_model_len")
+        # A prompt whose pages can never all fit would wait forever and
+        # starve the FCFS queue behind it; reject it up front.
+        prompt_pages = -(-(len(prompt_tokens) + 1) // self.page_size)
+        if prompt_pages > self.config.block_manager.total_pages - 1:
+            raise ValueError(
+                f"prompt needs {prompt_pages} pages but the pool holds only "
+                f"{self.config.block_manager.total_pages - 1}"
+            )
         seq = Sequence(
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
@@ -115,7 +123,6 @@ class Engine:
         out = self.scheduler.schedule()
         if out.prefill:
             self._run_prefill(out.prefill)
-            self.scheduler.on_prefill_done(out.prefill)
         elif out.decode:
             self._run_decode(out.decode)
 
@@ -162,8 +169,10 @@ class Engine:
         valid = np.zeros((b, chunk), bool)
         page_ids = np.zeros((b, chunk), np.int32)
         slot_ids = np.zeros((b, chunk), np.int32)
+        # Zero-width context when the whole batch is cache-cold: skips the
+        # per-layer context gather/score entirely (its own jit trace).
         max_ctx = max(s.num_cached_prompt // ps for s in seqs)
-        ctx_pages = max(4, _round_up(max_ctx, 4))
+        ctx_pages = _round_up(max_ctx, 4)
         ctx_bt = np.zeros((b, ctx_pages), np.int32)
         ctx_lens = np.zeros((b,), np.int32)
 
@@ -195,6 +204,9 @@ class Engine:
         )
         first_tokens = self._sample(logits, seqs)
         now = time.monotonic()
+        # Admit to running BEFORE appending slots: batchmates must be
+        # preemption candidates if page growth exhausts the pool here.
+        self.scheduler.on_prefill_done(seqs)
         for seq, tok in zip(seqs, first_tokens):
             if not seq.block_table:
                 continue  # preempted by an earlier seq in this very batch
@@ -260,11 +272,20 @@ class Engine:
             except AllocationError:
                 victim = None
                 for cand in reversed(self.scheduler.running):
-                    if cand is not seq and not cand.is_finished():
+                    # Never preempt sequences that are done generating (they
+                    # finish right after this loop) — re-prefilling one would
+                    # emit an extra token beyond its max_new_tokens contract.
+                    if cand is not seq and not self._should_finish(cand):
                         victim = cand
                         break
                 if victim is None:
-                    raise
+                    # Nothing left to reclaim: the pool cannot hold even this
+                    # one sequence. Abort the request rather than wedging the
+                    # whole engine.
+                    seq.error = "KV page pool too small for sequence growth"
+                    seq.sampling.max_new_tokens = seq.num_generated
+                    log.error("aborting sequence: pool exhausted", seq=seq.seq_id)
+                    return
                 log.warning(
                     "preempting sequence for pages",
                     victim=victim.seq_id,

@@ -73,10 +73,7 @@ class Scheduler:
             suffix = max(len(seq.prompt_tokens) - seq.num_cached_prompt, 1)
             if prefill and suffix > budget:
                 self.block_manager.free_sequence(seq)
-                seq.num_cached_prompt = 0
-                seq.num_computed = 0
-                seq.num_registered_pages = 0
-                seq.last_chain_hash = None
+                seq.reset_allocation()
                 break
             self.waiting.popleft()
             budget -= suffix

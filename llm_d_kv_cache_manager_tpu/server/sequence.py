@@ -52,6 +52,8 @@ class Sequence:
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    #: set when the engine had to abort the request (e.g. unschedulable)
+    error: Optional[str] = None
 
     def __post_init__(self):
         if self.user_prompt_len < 0:
@@ -70,15 +72,20 @@ class Sequence:
         """User-visible output, stable across preemption."""
         return self.all_tokens[self.user_prompt_len :]
 
+    def reset_allocation(self) -> None:
+        """Clear all page/prefix-cache bookkeeping (single source of truth
+        for rollback and preemption)."""
+        self.num_computed = 0
+        self.num_cached_prompt = 0
+        self.num_registered_pages = 0
+        self.last_chain_hash = None
+
     def fold_for_preemption(self) -> None:
         """Recompute-preemption: all tokens become the new 'prompt'; the
         re-prefill will cache-hit the pages that survived eviction."""
         self.prompt_tokens = self.all_tokens
         self.output_tokens = []
-        self.num_computed = 0
-        self.num_cached_prompt = 0
-        self.num_registered_pages = 0
-        self.last_chain_hash = None
+        self.reset_allocation()
         self.status = SequenceStatus.WAITING
 
     @property

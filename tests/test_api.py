@@ -1,0 +1,172 @@
+"""HTTP scoring-API tests (aiohttp test client, mock tokenizer, no network).
+
+Mirrors the reference online service surface (``online/main.go:238-363``)
+incl. the chat-completions flow with an injected template (the reference
+e2e does the same with a mock wrapper, ``e2e_test.go:227-358``).
+"""
+
+import asyncio
+import socket
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry
+from llm_d_kv_cache_manager_tpu.server.api import ScoringService, ServiceConfig
+from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
+
+MODEL = "test-model"
+TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message['role'] }}|>{{ message['content'] }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+
+class CharTokenizer(Tokenizer):
+    def encode(self, prompt, model_name):
+        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_service_scenario(scenario):
+    """Start the service + aiohttp test client, run the async scenario."""
+    service = ScoringService(
+        ServiceConfig(block_size=4, zmq_endpoint=f"tcp://*:{_free_port()}"),
+        tokenizer=CharTokenizer(),
+    )
+    service.start()
+
+    async def runner():
+        server = TestServer(service.build_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            await scenario(client, service)
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(runner())
+    finally:
+        service.shutdown()
+
+
+def _warm(service, prompt, pod="tpu-pod-1"):
+    keys = service.indexer.token_processor.tokens_to_kv_block_keys(
+        [ord(c) for c in prompt], MODEL
+    )
+    service.indexer.kv_block_index.add(keys, [PodEntry(pod)])
+    return keys
+
+
+class TestScoreCompletions:
+    def test_scores_warm_pod(self):
+        async def scenario(c, service):
+            prompt = "abcdefghijklmnop"
+            _warm(service, prompt)
+            resp = await c.post(
+                "/score_completions", json={"prompt": prompt, "model": MODEL}
+            )
+            assert resp.status == 200
+            assert (await resp.json())["scores"] == {"tpu-pod-1": 4}
+
+        run_service_scenario(scenario)
+
+    def test_cold_prompt_empty_scores(self):
+        async def scenario(c, service):
+            resp = await c.post(
+                "/score_completions",
+                json={"prompt": "something never seen here", "model": MODEL},
+            )
+            assert (await resp.json())["scores"] == {}
+
+        run_service_scenario(scenario)
+
+    def test_pod_filter(self):
+        async def scenario(c, service):
+            prompt = "abcdefgh"
+            _warm(service, prompt, pod="pod-a")
+            _warm(service, prompt, pod="pod-b")
+            resp = await c.post(
+                "/score_completions",
+                json={"prompt": prompt, "model": MODEL, "pod_identifiers": ["pod-b"]},
+            )
+            assert (await resp.json())["scores"] == {"pod-b": 2}
+
+        run_service_scenario(scenario)
+
+    def test_validation_errors(self):
+        async def scenario(c, service):
+            resp = await c.post("/score_completions", json={"model": MODEL})
+            assert resp.status == 400
+            resp = await c.post("/score_completions", json={"prompt": "x"})
+            assert resp.status == 400
+            resp = await c.post(
+                "/score_completions",
+                data=b"not json",
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 400
+
+        run_service_scenario(scenario)
+
+
+class TestScoreChatCompletions:
+    def test_renders_and_scores(self):
+        async def scenario(c, service):
+            messages = [
+                {"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hi"},
+            ]
+            rendered = "<|system|>be brief<|user|>hi<|assistant|>"
+            _warm(service, rendered)
+            resp = await c.post(
+                "/score_chat_completions",
+                json={"messages": messages, "model": MODEL, "chat_template": TEMPLATE},
+            )
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["rendered_prompt_chars"] == len(rendered)
+            assert data["scores"] == {"tpu-pod-1": len(rendered) // 4}
+
+        run_service_scenario(scenario)
+
+    def test_validation(self):
+        async def scenario(c, service):
+            resp = await c.post("/score_chat_completions", json={"model": MODEL})
+            assert resp.status == 400
+            resp = await c.post(
+                "/score_chat_completions", json={"messages": [], "model": MODEL}
+            )
+            assert resp.status == 400
+
+        run_service_scenario(scenario)
+
+
+class TestOps:
+    def test_healthz(self):
+        async def scenario(c, service):
+            resp = await c.get("/healthz")
+            assert resp.status == 200
+
+        run_service_scenario(scenario)
+
+    def test_metrics_exposition(self):
+        async def scenario(c, service):
+            await c.post(
+                "/score_completions", json={"prompt": "abcdefgh", "model": MODEL}
+            )
+            resp = await c.get("/metrics")
+            assert resp.status == 200
+            assert "kvcache_index_lookup_requests_total" in (await resp.text())
+
+        run_service_scenario(scenario)

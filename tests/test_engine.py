@@ -231,13 +231,29 @@ class TestPreemption:
 
     def test_preempted_output_reporting_stable(self):
         eng = _engine(total_pages=9, decode_batch=2)
-        a = eng.add_request(_prompt(52, 10), SamplingParams(max_new_tokens=10))
+        original_prompt = _prompt(52, 10)
+        a = eng.add_request(list(original_prompt), SamplingParams(max_new_tokens=10))
         eng.add_request(_prompt(53, 10), SamplingParams(max_new_tokens=10))
         eng.run_until_complete()
         # generated_tokens excludes the original prompt even if the sequence
         # was preempted (prompt folding must not leak into reported output).
         assert len(a.generated_tokens) == 10
-        assert a.all_tokens[: a.user_prompt_len] == a.all_tokens[:10]
+        assert a.all_tokens[: a.user_prompt_len] == [int(t) for t in original_prompt]
+
+    def test_oversized_prompt_rejected_upfront(self):
+        eng = _engine(total_pages=4)
+        with pytest.raises(ValueError, match="pages"):
+            eng.add_request(_prompt(60, 16), SamplingParams(max_new_tokens=1))
+
+    def test_pool_too_small_for_growth_aborts_with_error(self):
+        # One sequence, pool that cannot hold its growth: the request must
+        # abort with an error instead of wedging the engine.
+        eng = _engine(total_pages=4, decode_batch=1)
+        seq = eng.add_request(_prompt(61, 9), SamplingParams(max_new_tokens=30))
+        done = eng.run_until_complete(max_steps=500)
+        assert len(done) == 1
+        assert seq.error is not None
+        assert not eng.has_work
 
 
 class TestBlockManagerUnit:

@@ -1,0 +1,101 @@
+"""Multi-device sharding tests on the virtual 8-CPU mesh.
+
+Validates that tp/dp sharding is numerically transparent (sharded forward ==
+single-device forward) and that the full sharded training step runs and
+learns. The driver's dryrun_multichip covers the same path externally.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, init_params
+from llm_d_kv_cache_manager_tpu.parallel import (
+    MeshConfig,
+    batch_sharding,
+    make_mesh,
+    make_train_state,
+    param_shardings,
+    shard_params,
+    train_step,
+)
+from llm_d_kv_cache_manager_tpu.parallel.train import (
+    TrainState,
+    _forward_logits,
+    loss_fn,
+    make_optimizer,
+)
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices"
+)
+
+
+def _tokens(batch=4, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, TINY_LLAMA.vocab_size, (batch, seq)), jnp.int32)
+
+
+class TestSharding:
+    def test_sharded_forward_matches_single_device(self):
+        params = init_params(jax.random.PRNGKey(0), TINY_LLAMA)
+        tokens = _tokens()
+        ref = _forward_logits(params, TINY_LLAMA, tokens)
+
+        mesh = make_mesh(MeshConfig(dp=4, tp=2))
+        sharded = shard_params(params, mesh, TINY_LLAMA)
+        tok_sharded = jax.device_put(tokens, batch_sharding(mesh))
+        out = jax.jit(_forward_logits, static_argnames=("cfg",))(
+            sharded, TINY_LLAMA, tok_sharded
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_param_shardings_cover_tree(self):
+        mesh = make_mesh(MeshConfig(dp=4, tp=2))
+        params = init_params(jax.random.PRNGKey(0), TINY_LLAMA)
+        shardings = param_shardings(mesh, TINY_LLAMA)
+        # Tree structures must match exactly (every param gets a sharding).
+        jax.tree.map(lambda p, s: None, params, shardings)
+
+    def test_tp_actually_partitions(self):
+        mesh = make_mesh(MeshConfig(dp=1, tp=2))
+        params = init_params(jax.random.PRNGKey(0), TINY_LLAMA)
+        sharded = shard_params(params, mesh, TINY_LLAMA)
+        wq = sharded["layers"][0]["wq"]
+        shard_shapes = {s.data.shape for s in wq.addressable_shards}
+        # column-parallel: output dim split in 2
+        assert shard_shapes == {(TINY_LLAMA.hidden_size, TINY_LLAMA.n_heads * TINY_LLAMA.hd // 2)}
+
+
+class TestShardedTraining:
+    def test_train_step_runs_and_learns(self):
+        mesh = make_mesh(MeshConfig(dp=4, tp=2))
+        params = shard_params(
+            init_params(jax.random.PRNGKey(0), TINY_LLAMA), mesh, TINY_LLAMA
+        )
+        opt_state = jax.jit(make_optimizer().init)(params)
+        state = TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+        tokens = jax.device_put(_tokens(batch=8), batch_sharding(mesh))
+
+        losses = []
+        for _ in range(5):
+            state, loss = train_step(state, TINY_LLAMA, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]  # memorizing one batch must reduce loss
+        assert int(state.step) == 5
+
+    def test_sharded_loss_matches_unsharded(self):
+        params = init_params(jax.random.PRNGKey(1), TINY_LLAMA)
+        tokens = _tokens(seed=2)
+        ref = float(loss_fn(params, TINY_LLAMA, tokens))
+
+        mesh = make_mesh(MeshConfig(dp=2, tp=2))
+        sharded = shard_params(params, mesh, TINY_LLAMA)
+        tok_sharded = jax.device_put(tokens, batch_sharding(mesh))
+        got = float(
+            jax.jit(loss_fn, static_argnames=("cfg",))(sharded, TINY_LLAMA, tok_sharded)
+        )
+        assert abs(got - ref) < 1e-4
