@@ -1,0 +1,348 @@
+"""Headline benchmark: KV-cache-aware ("precise") routing vs round-robin.
+
+Reproduces the reference's capacity benchmarks (`benchmarking/37-capacity`,
+`73-capacity`: precise vs random/default scheduling under shared-prefix
+Poisson load) on TPU with the in-tree JAX serving engine, per the
+BASELINE.json north star: *p50-TTFT reduction vs round-robin on
+shared-prefix load*.
+
+Method — virtual-clock fleet co-simulation on one real chip:
+
+- N "pods", each a real `Engine` (own KV page pool, block manager,
+  continuous-batching scheduler) running the real Pallas paged-attention
+  model; all pods share one copy of the weights (pods differ only by KV
+  cache state, which is what routing exploits).
+- Each pod has a virtual clock advanced by the *measured wall time* of its
+  engine steps on the TPU. Pods are independent machines in a real
+  deployment, so time-slicing them on one chip while accounting time
+  per-pod is a faithful simulation of fleet behavior.
+- KV events flow through the real write path: BlockStored/BlockRemoved →
+  msgpack EventBatch → sharded KVEventsPool → shared in-memory block index
+  (SURVEY §3.2). The router's read path is `KVCacheIndexer.score_tokens`
+  (chunked sha256-CBOR hashing + longest-prefix scorer, SURVEY §3.1).
+- Workload: G prefix groups (default 32-way), each a shared prefix of
+  `PREFIX_LEN` tokens plus a unique suffix; Poisson arrivals.
+- Policies: `round_robin` and `precise` (max indexer score, ties to the
+  least-loaded pod). p50 TTFT measured in virtual time for each.
+
+Prints ONE JSON line:
+  {"metric": "p50_ttft_reduction_vs_round_robin", "value": <pct>,
+   "unit": "%", "vs_baseline": <pct/50>}
+vs_baseline >= 1.0 means the north-star target (>=50% reduction) is met.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+MODEL_NAME = "bench/llama"
+
+
+def build_workload(rng, n_groups, reqs_per_group, prefix_len, suffix_len, vocab, qps):
+    """Poisson arrival schedule over shared-prefix groups.
+
+    Returns [(arrival_time, group_id, tokens)] sorted by arrival, with
+    group order shuffled so consecutive arrivals mix groups.
+    """
+    prefixes = [
+        rng.integers(0, vocab, prefix_len).tolist() for _ in range(n_groups)
+    ]
+    reqs = []
+    for g in range(n_groups):
+        for _ in range(reqs_per_group):
+            reqs.append((g, prefixes[g] + rng.integers(0, vocab, suffix_len).tolist()))
+    rng.shuffle(reqs)
+    t = 0.0
+    out = []
+    for g, toks in reqs:
+        t += float(rng.exponential(1.0 / qps))
+        out.append((t, g, toks))
+    return out
+
+
+class Pod:
+    """One simulated serving replica: a real engine + a virtual clock."""
+
+    def __init__(self, pod_id, engine_cfg, params, publish):
+        from llm_d_kv_cache_manager_tpu.server.engine import Engine
+
+        self.pod_id = pod_id
+        self.engine = Engine(engine_cfg, params=params, on_events=publish(pod_id))
+        self.clock = 0.0
+        self._first_token_seen: set[int] = set()
+
+    @property
+    def load(self) -> int:
+        s = self.engine.scheduler
+        return len(s.waiting) + len(s.running)
+
+    def step_timed(self, ttfts, arrivals):
+        t0 = time.perf_counter()
+        self.engine.step()
+        self.clock += time.perf_counter() - t0
+        # Record first-token virtual times.
+        sched = self.engine.scheduler
+        for seq in list(sched.running) + self.engine.finished:
+            if seq.num_generated >= 1 and seq.seq_id not in self._first_token_seen:
+                self._first_token_seen.add(seq.seq_id)
+                if seq.seq_id in arrivals:
+                    ttfts.append(self.clock - arrivals[seq.seq_id])
+
+    def advance_to(self, t, ttfts, arrivals):
+        while self.engine.has_work and self.clock < t:
+            self.step_timed(ttfts, arrivals)
+
+    def drain(self, ttfts, arrivals, max_steps=200_000):
+        for _ in range(max_steps):
+            if not self.engine.has_work:
+                return
+            self.step_timed(ttfts, arrivals)
+        raise RuntimeError("pod failed to drain")
+
+
+def make_event_pipeline(index, n_pods):
+    """Real write path: msgpack-encode batches, shard into the events pool."""
+    from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+        KVEventsPool,
+        KVEventsPoolConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvevents.events import EventBatch
+    from llm_d_kv_cache_manager_tpu.kvcache.kvevents.pool import Message
+
+    pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=min(4, n_pods)))
+    pool.start()
+
+    def publish(pod_id):
+        pod_name = f"tpu-pod-{pod_id}"
+
+        def on_events(events):
+            batch = EventBatch(ts=0.0, events=list(events))
+            pool.add_task(
+                Message(
+                    topic=f"kv@{pod_name}@{MODEL_NAME}",
+                    pod_identifier=pod_name,
+                    model_name=MODEL_NAME,
+                    payload=batch.to_payload(),
+                )
+            )
+
+        return on_events
+
+    return pool, publish
+
+
+def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
+    """Run one routing policy over the workload; returns virtual-time TTFTs."""
+    from llm_d_kv_cache_manager_tpu.kvcache import (
+        KVCacheIndexer,
+        KVCacheIndexerConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import TokenProcessorConfig
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    page = engine_cfg.block_manager.page_size
+    indexer = KVCacheIndexer(
+        KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=page))
+    )
+    pool, publish = make_event_pipeline(indexer.kv_block_index, n_pods)
+    pods = [Pod(i, engine_cfg, params, publish) for i in range(n_pods)]
+    pod_names = [f"tpu-pod-{i}" for i in range(n_pods)]
+
+    ttfts: list[float] = []
+    arrivals: dict[int, float] = {}
+    rr = 0
+    for t, _group, tokens in workload:
+        # Advance every pod to the arrival instant so the index reflects
+        # fleet state at routing time, then drain in-flight events.
+        for pod in pods:
+            pod.advance_to(t, ttfts, arrivals)
+        if policy == "precise":
+            pool.drain(timeout=10.0)
+            scores = indexer.score_tokens(tokens, MODEL_NAME, pod_names)
+            best = max(
+                range(n_pods),
+                key=lambda i: (scores.get(pod_names[i], 0), -pods[i].load, -i),
+            )
+        else:
+            best = rr % n_pods
+            rr += 1
+        pod = pods[best]
+        if not pod.engine.has_work:
+            pod.clock = max(pod.clock, t)
+        seq = pod.engine.add_request(
+            tokens, SamplingParams(max_new_tokens=max_new_tokens)
+        )
+        arrivals[seq.seq_id] = t
+    for pod in pods:
+        pod.drain(ttfts, arrivals)
+    pool.drain(timeout=10.0)
+    pool.shutdown()
+    indexer.shutdown()
+    n_req = len(workload)
+    assert len(ttfts) == n_req, f"lost requests: {len(ttfts)}/{n_req}"
+    return np.asarray(ttfts)
+
+
+def warmup(params, engine_cfg, prefix_len, suffix_len, vocab, max_new_tokens):
+    """Compile every jit shape the measured runs will hit (cold prefill,
+    warm suffix-only prefill, mixed batch, decode) on a scratch engine."""
+    from llm_d_kv_cache_manager_tpu.server.engine import Engine
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    rng = np.random.default_rng(1234)
+    eng = Engine(engine_cfg, params=params)
+    prefix = rng.integers(0, vocab, prefix_len).tolist()
+
+    def req():
+        return eng.add_request(
+            prefix + rng.integers(0, vocab, suffix_len).tolist(),
+            SamplingParams(max_new_tokens=max_new_tokens),
+        )
+
+    req()  # cold: (chunk=full, ctx=0)
+    eng.run_until_complete()
+    req()  # warm: (chunk=suffix bucket, ctx=max)
+    eng.run_until_complete()
+    cold = rng.integers(0, vocab, prefix_len + suffix_len).tolist()
+    eng.add_request(cold, SamplingParams(max_new_tokens=max_new_tokens))
+    req()  # mixed cold+warm batch: (chunk=full, ctx=max)
+    eng.run_until_complete()
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from llm_d_kv_cache_manager_tpu.models import llama
+    from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+    from llm_d_kv_cache_manager_tpu.server.block_manager import BlockManagerConfig
+    from llm_d_kv_cache_manager_tpu.server.engine import EngineConfig
+    from llm_d_kv_cache_manager_tpu.server.scheduler import SchedulerConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    smoke = os.environ.get("BENCH_SMOKE") == "1" or not on_tpu
+
+    if smoke:
+        model_cfg = llama.TINY_LLAMA
+        n_pods, n_groups, reqs_per_group = 2, 4, 3
+        prefix_len, suffix_len, max_new = 64, 16, 4
+        total_pages, page = 256, 16
+        decode_burst = 2
+        interpret = not on_tpu
+    else:
+        # Llama-3-8B-family architecture scaled (1.4B) so a 4-pod fleet
+        # (one weight copy + 4 KV pools) fits one v5e chip while cold
+        # prefills stay compute-bound — the analogue of the reference's
+        # 8k-prefix/70B capacity runs.
+        model_cfg = LlamaConfig(
+            vocab_size=32_000,
+            hidden_size=3072,
+            intermediate_size=8192,
+            n_layers=12,
+            n_heads=24,
+            n_kv_heads=8,
+            rope_scaling=llama.LLAMA_3_8B.rope_scaling,
+            dtype=jnp.bfloat16,
+        )
+        n_pods, n_groups, reqs_per_group = 4, 32, 8
+        prefix_len, suffix_len, max_new = 4096, 48, 16
+        # Pool sized so a precise pod's share of prefixes (~8 groups ×
+        # 257 pages) stays resident while a round-robin pod (which sees
+        # all 32 prefixes) thrashes its prefix cache — the regime of the
+        # reference's capacity benchmarks.
+        total_pages, page = 2560, 16
+        decode_burst = 8
+        interpret = False
+
+    max_len = prefix_len + suffix_len + max_new + page
+    engine_cfg = EngineConfig(
+        model=model_cfg,
+        block_manager=BlockManagerConfig(total_pages=total_pages, page_size=page),
+        scheduler=SchedulerConfig(max_prefill_batch=4, max_prefill_tokens=8192),
+        max_model_len=max_len,
+        decode_batch_size=8,
+        decode_steps_per_iter=decode_burst,
+        prefill_bucket=64,
+        # Pin warm prefills to a single ctx width → one compiled shape.
+        prefill_ctx_bucket=-(-max_len // page),
+        interpret=interpret,
+    )
+
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    jax.block_until_ready(params)
+
+    warmup(params, engine_cfg, prefix_len, suffix_len, model_cfg.vocab_size, max_new)
+
+    # Calibrate the arrival rate off the measured cold-request service time
+    # so round-robin saturates (its regime in the reference benchmarks:
+    # random/RR explodes to ~85 s TTFT while precise stays sub-second)
+    # without hand-tuned absolute QPS.
+    from llm_d_kv_cache_manager_tpu.server.engine import Engine
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    cal_rng = np.random.default_rng(7)
+    cal_eng = Engine(engine_cfg, params=params)
+    batch_w = engine_cfg.scheduler.max_prefill_batch
+    t0 = time.perf_counter()
+    for _ in range(batch_w):
+        cal_eng.add_request(
+            cal_rng.integers(0, model_cfg.vocab_size, prefix_len + suffix_len).tolist(),
+            SamplingParams(max_new_tokens=max_new),
+        )
+    cal_eng.run_until_complete()
+    t_cold = (time.perf_counter() - t0) / batch_w  # per-request, batched cold
+    del cal_eng  # release its KV pool before building the fleet
+    qps = 1.4 * n_pods / max(t_cold, 1e-4)
+
+    rng = np.random.default_rng(42)
+    workload = build_workload(
+        rng, n_groups, reqs_per_group, prefix_len, suffix_len,
+        model_cfg.vocab_size, qps,
+    )
+
+    results = {}
+    for policy in ("round_robin", "precise"):
+        ttfts = run_policy(policy, workload, params, engine_cfg, n_pods, max_new)
+        results[policy] = {
+            "p50_ttft_s": float(np.median(ttfts)),
+            "p90_ttft_s": float(np.percentile(ttfts, 90)),
+            "mean_ttft_s": float(np.mean(ttfts)),
+        }
+
+    p50_rr = results["round_robin"]["p50_ttft_s"]
+    p50_pr = results["precise"]["p50_ttft_s"]
+    reduction = 100.0 * (p50_rr - p50_pr) / p50_rr if p50_rr > 0 else 0.0
+
+    detail = {
+        "backend": jax.default_backend(),
+        "smoke": smoke,
+        "n_pods": n_pods,
+        "n_groups": n_groups,
+        "n_requests": len(workload),
+        "prefix_len": prefix_len,
+        "qps": round(qps, 2),
+        "results": results,
+    }
+    print(json.dumps(detail), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "p50_ttft_reduction_vs_round_robin",
+                "value": round(reduction, 2),
+                "unit": "%",
+                "vs_baseline": round(reduction / 50.0, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

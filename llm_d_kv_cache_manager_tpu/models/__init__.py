@@ -3,6 +3,7 @@ from .llama import (
     init_params,
     prefill,
     decode_step,
+    decode_steps,
     init_kv_pages,
     LLAMA_3_8B,
     LLAMA_3_70B,
@@ -14,6 +15,7 @@ __all__ = [
     "init_params",
     "prefill",
     "decode_step",
+    "decode_steps",
     "init_kv_pages",
     "LLAMA_3_8B",
     "LLAMA_3_70B",

@@ -186,7 +186,9 @@ def _scatter_kv_pages(
     return flat.reshape(n_kv, total_pages, page_size, hd)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnames=("k_pages", "v_pages")
+)
 def prefill(
     params: Params,
     cfg: LlamaConfig,
@@ -245,8 +247,7 @@ def prefill(
     return _logits(params, cfg, h_last[:, None, :])[:, 0], k_pages, v_pages
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "page_size", "interpret"))
-def decode_step(
+def _decode_body(
     params: Params,
     cfg: LlamaConfig,
     tokens: jnp.ndarray,  # [b] int32 — last sampled token per sequence
@@ -255,12 +256,12 @@ def decode_step(
     v_pages: jnp.ndarray,
     block_tables: jnp.ndarray,  # [b, max_pages] int32
     seq_lens: jnp.ndarray,  # [b] int32 — context length INCLUDING this token
-    *,
     page_size: int,
-    interpret: bool = False,
+    interpret: bool,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One decode step for a batch of sequences. Writes this token's K/V
-    into its page slot, runs paged attention over the full context, returns
+    """Single decode step (traced body shared by ``decode_step`` and the
+    fused ``decode_steps`` scan). Writes this token's K/V into its page
+    slot, runs paged attention over the full context, returns
     (logits [b, vocab], k_pages, v_pages)."""
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     b = tokens.shape[0]
@@ -307,3 +308,81 @@ def decode_step(
         jnp.stack(new_k_pages),
         jnp.stack(new_v_pages),
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "page_size", "interpret"),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def decode_step(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [b] int32 — last sampled token per sequence
+    positions: jnp.ndarray,  # [b] int32 — position of this token
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [b, max_pages] int32
+    seq_lens: jnp.ndarray,  # [b] int32 — context length INCLUDING this token
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step; sampling stays with the caller (host or jit)."""
+    return _decode_body(
+        params, cfg, tokens, positions, k_pages, v_pages,
+        block_tables, seq_lens, page_size, interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "page_size", "num_steps", "interpret"),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def decode_steps(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [b] int32 — last sampled token per sequence
+    positions: jnp.ndarray,  # [b] int32 — position of `tokens`
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [b, max_pages] int32 (covers num_steps growth)
+    seq_lens: jnp.ndarray,  # [b] int32 — context length INCLUDING `tokens`
+    temperature: jnp.ndarray,  # [b] f32; 0 = greedy
+    top_k: jnp.ndarray,  # [b] int32; 0 = disabled
+    top_p: jnp.ndarray,  # [b] f32; 1 = disabled
+    rng_key: jax.Array,
+    *,
+    page_size: int,
+    num_steps: int,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``num_steps`` fused decode iterations with on-device sampling.
+
+    The device-resident decode loop: one ``lax.scan`` over single-step
+    bodies, sampling each next token on-device, so the host syncs once per
+    ``num_steps`` tokens instead of once per token. This is the TPU-native
+    answer to per-dispatch host latency (the reference never runs a model;
+    its vLLM pods solve this on the GPU side). Returns (sampled tokens
+    [b, num_steps] int32, k_pages, v_pages). The caller must pre-extend
+    ``block_tables`` to cover ``num_steps`` of growth; lanes that finish
+    early keep decoding into their reserved pages and the host discards the
+    surplus tokens.
+    """
+    from ..ops.sampling import sample_tokens
+
+    def body(carry, key):
+        tokens, positions, seq_lens, k_pages, v_pages = carry
+        logits, k_pages, v_pages = _decode_body(
+            params, cfg, tokens, positions, k_pages, v_pages,
+            block_tables, seq_lens, page_size, interpret,
+        )
+        nxt = sample_tokens(logits.astype(jnp.float32), temperature, top_k, top_p, key)
+        return (nxt, positions + 1, seq_lens + 1, k_pages, v_pages), nxt
+
+    keys = jax.random.split(rng_key, num_steps)
+    (_, _, _, k_pages, v_pages), toks = jax.lax.scan(
+        body, (tokens, positions, seq_lens, k_pages, v_pages), keys
+    )
+    return toks.T, k_pages, v_pages

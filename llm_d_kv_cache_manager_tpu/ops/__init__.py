@@ -2,8 +2,10 @@ from .rmsnorm import rms_norm
 from .rope import apply_rope, rope_frequencies
 from .attention import causal_prefill_attention, prefill_with_paged_context
 from .paged_attention import paged_attention, paged_attention_reference
+from .sampling import sample_tokens
 
 __all__ = [
+    "sample_tokens",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",

@@ -59,6 +59,76 @@ def causal_prefill_attention(
     return out.reshape(b, s, n_q, d).astype(q.dtype)
 
 
+#: key-block length for the online-softmax prefill scan. 512 keeps the
+#: per-block score tile MXU-sized while bounding live memory to
+#: O(seq × block) instead of O(seq²).
+FLASH_KEY_BLOCK = 512
+
+_NEG_INF = -1e30
+
+
+def _flash_over_keys(
+    qf: jnp.ndarray,  # [b, s, n_kv, group, d] f32
+    k_all: jnp.ndarray,  # [b, n_kv, T, d]
+    v_all: jnp.ndarray,  # [b, n_kv, T, d]
+    k_valid: jnp.ndarray,  # [b, T] bool
+    k_pos: jnp.ndarray,  # [b, T] int32 (visibility: k_pos <= q_pos)
+    q_pos: jnp.ndarray,  # [b, s] int32
+    scale: float,
+    block: int,
+) -> jnp.ndarray:
+    """Online-softmax (flash) attention over a virtual key sequence, scanned
+    in key blocks so the [s, T] score matrix is never materialized — the
+    memory shape XLA wants for long-context prefill on TPU (score tile
+    [s, block] is reused across scan iterations)."""
+    b, s, n_kv, group, d = qf.shape
+    T = k_all.shape[2]
+    # Short key sequences (cache-cold short prompts) shrink the block to a
+    # lane-aligned size instead of padding up to a full block of masked work.
+    block = min(block, -(-T // 128) * 128)
+    n_blocks = -(-T // block)
+    pad = n_blocks * block - T
+    if pad:
+        k_all = jnp.pad(k_all, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v_all = jnp.pad(v_all, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        k_valid = jnp.pad(k_valid, ((0, 0), (0, pad)))
+        k_pos = jnp.pad(k_pos, ((0, 0), (0, pad)))
+
+    kb = k_all.reshape(b, n_kv, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
+    vb = v_all.reshape(b, n_kv, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
+    valb = k_valid.reshape(b, n_blocks, block).transpose(1, 0, 2)
+    posb = k_pos.reshape(b, n_blocks, block).transpose(1, 0, 2)
+
+    m0 = jnp.full((b, n_kv, group, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n_kv, group, s), jnp.float32)
+    acc0 = jnp.zeros((b, n_kv, group, s, d), jnp.float32)
+
+    def body(carry, blk):
+        m, l, acc = carry
+        kblk, vblk, vblk_valid, pblk = blk
+        scores = jnp.einsum(
+            "bqhgd,bhtd->bhgqt", qf, kblk.astype(jnp.float32)
+        ) * scale  # [b, n_kv, g, s, block]
+        mask = (
+            vblk_valid[:, None, None, None, :]
+            & (pblk[:, None, None, None, :] <= q_pos[:, None, None, :, None])
+        )
+        scores = jnp.where(mask, scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None]) * mask
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhgqt,bhtd->bhgqd", p, vblk.astype(jnp.float32)
+        )
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (kb, vb, valb, posb))
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+    # [b, n_kv, g, s, d] -> [b, s, n_kv, g, d]
+    return out.transpose(0, 3, 1, 2, 4)
+
+
 def prefill_with_paged_context(
     q: jnp.ndarray,  # [batch, seq, n_heads, head_dim] — the fresh chunk
     k: jnp.ndarray,  # [batch, seq, n_kv_heads, head_dim]
@@ -81,7 +151,9 @@ def prefill_with_paged_context(
     request only prefills its suffix. Context tokens all precede the chunk,
     so cross-attention to them needs only the ctx_len mask, not a causal one.
 
-    One fused softmax over [context ++ chunk] keys. Returns
+    One online softmax over the virtual key sequence [context ++ chunk],
+    flash-scanned in ``FLASH_KEY_BLOCK``-sized key blocks (memory stays
+    O(seq × block), enabling multi-k-token prefills). Returns
     [batch, seq, n_heads, head_dim].
     """
     b, s, n_q, d = q.shape
@@ -94,29 +166,25 @@ def prefill_with_paged_context(
     qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
 
     # Context keys/values gathered per sequence: [b, n_kv, max_ctx, d].
-    page_size = k_pages.shape[2]
     ctx_k = jnp.moveaxis(k_pages[:, block_tables], 0, 1).reshape(b, n_kv, max_ctx, d)
     ctx_v = jnp.moveaxis(v_pages[:, block_tables], 0, 1).reshape(b, n_kv, max_ctx, d)
 
-    ctx_scores = jnp.einsum("bqhgd,bhtd->bhgqt", qf, ctx_k.astype(jnp.float32)) * scale
-    ctx_mask = (
-        jnp.arange(max_ctx)[None, None, None, None, :] < ctx_lens[:, None, None, None, None]
+    # Virtual key sequence: [context ++ chunk]. Context keys are visible to
+    # every query (they strictly precede the chunk): position -1 ≤ any
+    # q_pos ≥ 0. Chunk keys follow causal position order.
+    k_all = jnp.concatenate([ctx_k, jnp.moveaxis(k, 1, 2)], axis=2)
+    v_all = jnp.concatenate([ctx_v, jnp.moveaxis(v, 1, 2)], axis=2)
+    ctx_valid = jnp.arange(max_ctx)[None, :] < ctx_lens[:, None]
+    chunk_valid = (
+        valid if valid is not None else jnp.ones((b, s), bool)
     )
-    ctx_scores = jnp.where(ctx_mask, ctx_scores, -jnp.inf)
-
-    chunk_scores = (
-        jnp.einsum("bqhgd,bkhd->bhgqk", qf, k.astype(jnp.float32)) * scale
+    k_valid = jnp.concatenate([ctx_valid, chunk_valid], axis=1)
+    k_pos = jnp.concatenate(
+        [jnp.full((b, max_ctx), -1, jnp.int32), positions.astype(jnp.int32)], axis=1
     )
-    chunk_mask = positions[:, None, None, :, None] >= positions[:, None, None, None, :]
-    if valid is not None:
-        chunk_mask = chunk_mask & valid[:, None, None, None, :]
-    chunk_scores = jnp.where(chunk_mask, chunk_scores, -jnp.inf)
 
-    scores = jnp.concatenate([ctx_scores, chunk_scores], axis=-1)
-    probs = jax.nn.softmax(scores, axis=-1)
-    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
-
-    out = jnp.einsum(
-        "bhgqt,bhtd->bqhgd", probs[..., :max_ctx], ctx_v.astype(jnp.float32)
-    ) + jnp.einsum("bhgqk,bkhd->bqhgd", probs[..., max_ctx:], v.astype(jnp.float32))
+    out = _flash_over_keys(
+        qf, k_all, v_all, k_valid, k_pos, positions.astype(jnp.int32),
+        scale, FLASH_KEY_BLOCK,
+    )
     return out.reshape(b, s, n_q, d).astype(q.dtype)

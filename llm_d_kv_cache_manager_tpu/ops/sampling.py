@@ -1,0 +1,52 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jit-compiled.
+
+One fused function over the batch — sampling params are per-sequence arrays
+so mixed strategies share a single compiled program (no per-request
+recompiles, XLA-friendly static shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sample_tokens(
+    logits: jnp.ndarray,  # [batch, vocab] f32
+    temperature: jnp.ndarray,  # [batch] f32; 0 = greedy
+    top_k: jnp.ndarray,  # [batch] int32; 0 = disabled
+    top_p: jnp.ndarray,  # [batch] f32; 1 = disabled
+    rng_key: jax.Array,
+) -> jnp.ndarray:
+    """Returns sampled token ids [batch] int32."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Temperature scaling (guard 0 for the greedy lanes).
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+
+    # Top-k mask: keep the k highest logits per row.
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [b, vocab]
+    k = jnp.where(top_k > 0, top_k, vocab).astype(jnp.int32)
+    kth_val = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k - 1, 0, vocab - 1)[:, None], axis=-1
+    )
+    masked = jnp.where(scaled >= kth_val, scaled, -jnp.inf)
+
+    # Top-p (nucleus) on the surviving distribution.
+    sorted_masked = jnp.sort(masked, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens while cumulative prob (exclusive) < top_p
+    cutoff_mask = (cumprobs - probs_sorted) < top_p[:, None]
+    threshold = jnp.min(
+        jnp.where(cutoff_mask, sorted_masked, jnp.inf), axis=-1, keepdims=True
+    )
+    masked = jnp.where(masked >= threshold, masked, -jnp.inf)
+
+    sampled = jax.random.categorical(rng_key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

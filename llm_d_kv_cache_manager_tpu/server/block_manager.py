@@ -195,6 +195,17 @@ class BlockManager:
         if seq.num_tokens > len(seq.block_table) * ps:
             seq.block_table.append(self._pop_free_page())
 
+    def reserve_slots(self, seq: Sequence, n: int) -> None:
+        """Ensure KV-slot capacity for a fused decode burst: positions up to
+        ``num_tokens + n - 1`` (token ``num_tokens - 1`` is the burst input;
+        step j writes KV at position ``num_tokens - 1 + j``). Allocates all
+        crossing pages up front; on exhaustion mid-way the partial growth is
+        kept (the caller's preempt-and-retry loop continues from it)."""
+        ps = self.config.page_size
+        needed = -(-(seq.num_tokens + n - 1) // ps)
+        while len(seq.block_table) < needed:
+            seq.block_table.append(self._pop_free_page())
+
     def register_full_pages(self, seq: Sequence) -> None:
         """Hash + cache-register any newly-completed pages of ``seq`` and
         queue their BlockStored events. Called after compute has written the

@@ -28,7 +28,7 @@ from ..models import llama
 from ..models.llama import LlamaConfig
 from ..utils import get_logger
 from .block_manager import BlockManager, BlockManagerConfig
-from .sampling import sample_tokens
+from ..ops.sampling import sample_tokens
 from .scheduler import Scheduler, SchedulerConfig
 from .sequence import SamplingParams, Sequence, SequenceStatus
 
@@ -47,8 +47,15 @@ class EngineConfig:
     max_model_len: int = 2048
     #: decode batch lanes (padded); also the max concurrent running seqs
     decode_batch_size: int = 8
+    #: fused decode steps per engine iteration (device-resident loop with
+    #: on-device sampling — one host sync per this many tokens). 1 = the
+    #: classic step-per-token path with host-side sampling.
+    decode_steps_per_iter: int = 1
     #: prefill length bucket granularity (shape-bucketing for jit caching)
     prefill_bucket: int = 64
+    #: context block-table width bucket granularity for warm prefills; raise
+    #: to the max pages/seq to pin one shape (fewer XLA recompiles)
+    prefill_ctx_bucket: int = 4
     #: run Pallas kernels in interpreter mode (CPU tests)
     interpret: bool = False
     seed: int = 0
@@ -66,7 +73,12 @@ class Engine:
         self.model_cfg = cfg
         ps = config.block_manager.page_size
         self.page_size = ps
-        self.max_pages_per_seq = -(-config.max_model_len // ps)
+        # Width includes fused-burst headroom: a sequence finishing at
+        # max_model_len mid-burst keeps writing its surplus KV into reserved
+        # pages of its own row, never into another sequence's pages.
+        self.max_pages_per_seq = -(
+            -(config.max_model_len + max(config.decode_steps_per_iter - 1, 0)) // ps
+        )
 
         self.block_manager = BlockManager(config.block_manager, on_events=on_events)
         import dataclasses
@@ -172,7 +184,7 @@ class Engine:
         # Zero-width context when the whole batch is cache-cold: skips the
         # per-layer context gather/score entirely (its own jit trace).
         max_ctx = max(s.num_cached_prompt // ps for s in seqs)
-        ctx_pages = _round_up(max_ctx, 4)
+        ctx_pages = _round_up(max_ctx, self.config.prefill_ctx_bucket)
         ctx_bt = np.zeros((b, ctx_pages), np.int32)
         ctx_lens = np.zeros((b,), np.int32)
 
@@ -219,6 +231,9 @@ class Engine:
             self.block_manager.register_full_pages(seq)
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
+        if self.config.decode_steps_per_iter > 1:
+            self._run_decode_fused(seqs)
+            return
         lanes = self.config.decode_batch_size
         assert len(seqs) <= lanes
         tokens = np.zeros((lanes,), np.int32)
@@ -257,17 +272,96 @@ class Engine:
             self._append_slot_or_preempt(seq)
             self.block_manager.register_full_pages(seq)
 
+    def _run_decode_fused(self, seqs: list[Sequence]) -> None:
+        """Fused multi-token decode: reserve page capacity for the whole
+        burst up front, run ``decode_steps`` (on-device sampling, single
+        host sync), then commit sampled tokens per sequence, truncating at
+        stop conditions. Surplus device-side KV writes land in pages the
+        sequence owns (or reserved page 0 for padded lanes) and are never
+        registered in the prefix cache, so discarding them is safe."""
+        k = self.config.decode_steps_per_iter
+        lanes = self.config.decode_batch_size
+        assert len(seqs) <= lanes
+
+        # Reserve capacity for k tokens of growth per sequence; preemption
+        # inside reservation may knock later batchmates out of `seqs`.
+        for seq in seqs:
+            if seq.block_table:
+                self._reserve_slots_or_preempt(seq, k)
+        active = [s for s in seqs if s.block_table]
+        if not active:
+            return
+
+        tokens = np.zeros((lanes,), np.int32)
+        positions = np.zeros((lanes,), np.int32)
+        seq_lens = np.zeros((lanes,), np.int32)  # 0 = inactive lane
+        block_tables = np.zeros((lanes, self.max_pages_per_seq), np.int32)
+        temperature = np.zeros((lanes,), np.float32)
+        top_k = np.zeros((lanes,), np.int32)
+        top_p = np.ones((lanes,), np.float32)
+
+        for i, seq in enumerate(active):
+            tokens[i] = seq.all_tokens[-1]
+            positions[i] = seq.num_tokens - 1
+            seq_lens[i] = seq.num_tokens
+            bt = seq.block_table
+            block_tables[i, : len(bt)] = bt
+            temperature[i] = seq.sampling.temperature
+            top_k[i] = seq.sampling.top_k
+            top_p[i] = seq.sampling.top_p
+
+        self._rng, key = jax.random.split(self._rng)
+        toks, self.k_pages, self.v_pages = llama.decode_steps(
+            self.params,
+            self.model_cfg,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(block_tables),
+            jnp.asarray(seq_lens),
+            jnp.asarray(temperature),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            key,
+            page_size=self.page_size,
+            num_steps=k,
+            interpret=self.config.interpret,
+        )
+        toks = np.asarray(toks)  # [lanes, k] — the one host sync
+        for i, seq in enumerate(active):
+            for j in range(k):
+                # Pre-check keeps the num_generated <= max_new_tokens
+                # invariant even when a reservation abort clamped the cap
+                # before the burst ran.
+                if self._should_finish(seq):
+                    break
+                seq.num_computed = seq.num_tokens
+                seq.output_tokens.append(int(toks[i, j]))
+                seq.num_generated += 1
+            self.block_manager.register_full_pages(seq)
+
+    def _reserve_slots_or_preempt(self, seq: Sequence, n: int) -> None:
+        """Ensure ``seq`` can grow by ``n`` tokens (KV slots for positions
+        up to ``num_tokens + n - 1``) — preemption policy shared with
+        ``_append_slot_or_preempt``."""
+        self._grow_or_preempt(seq, lambda: self.block_manager.reserve_slots(seq, n))
+
     def _append_slot_or_preempt(self, seq: Sequence) -> None:
-        """Grow ``seq`` by one slot; on pool exhaustion, preempt the most
-        recently admitted *other* running sequence (recompute-style: its
-        pages are freed — surviving cached pages make its later re-prefill
-        cheap — and it requeues). Raises only when ``seq`` is alone and the
-        pool still cannot grow (pool smaller than one sequence)."""
+        """Grow ``seq`` by one slot, preempting on pool exhaustion."""
+        self._grow_or_preempt(seq, lambda: self.block_manager.append_slot(seq))
+
+    def _grow_or_preempt(self, seq: Sequence, grow) -> None:
+        """Run ``grow()``; on pool exhaustion, preempt the most recently
+        admitted *other* running sequence (recompute-style: its pages are
+        freed — surviving cached pages make its later re-prefill cheap —
+        and it requeues). When nothing is left to reclaim, aborts ``seq``
+        rather than wedging the engine."""
         from .block_manager import AllocationError
 
         while True:
             try:
-                self.block_manager.append_slot(seq)
+                grow()
                 return
             except AllocationError:
                 victim = None

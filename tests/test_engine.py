@@ -289,3 +289,65 @@ class TestBlockManagerUnit:
         # all pages now evictable; a big new allocation recycles them
         s3 = Sequence(prompt_tokens=list(range(14 * PS)))
         bm.allocate(s3)
+
+
+class TestFusedDecode:
+    """decode_steps_per_iter > 1: device-resident multi-token decode."""
+
+    def test_fused_greedy_matches_per_step(self):
+        prompts = [_prompt(i, 9 + i) for i in range(3)]
+        outs = []
+        for k in (1, 4):
+            eng = _engine(decode_steps_per_iter=k)
+            seqs = [
+                eng.add_request(p, SamplingParams(max_new_tokens=7))
+                for p in prompts
+            ]
+            eng.run_until_complete()
+            outs.append([s.output_tokens for s in seqs])
+        assert outs[0] == outs[1]
+
+    def test_fused_respects_max_new_tokens(self):
+        # max_new not a multiple of the burst: surplus tokens discarded.
+        eng = _engine(decode_steps_per_iter=4)
+        seq = eng.add_request(_prompt(1, 10), SamplingParams(max_new_tokens=6))
+        eng.run_until_complete()
+        assert len(seq.output_tokens) == 6
+
+    def test_fused_stop_token_truncates(self):
+        eng = _engine(decode_steps_per_iter=4)
+        probe = eng.add_request(_prompt(2, 8), SamplingParams(max_new_tokens=3))
+        eng.run_until_complete()
+        stop = probe.output_tokens[1]
+        eng2 = _engine(decode_steps_per_iter=4)
+        seq = eng2.add_request(
+            _prompt(2, 8), SamplingParams(max_new_tokens=8, stop_token_ids=(stop,))
+        )
+        eng2.run_until_complete()
+        assert seq.output_tokens[-1] == stop
+        assert len(seq.output_tokens) == 2
+
+    def test_fused_prefix_cache_still_consistent(self):
+        # Same-prefix request after fused decode must produce identical
+        # tokens (cached pages registered only for committed tokens).
+        p = _prompt(3, 16)
+        eng = _engine(decode_steps_per_iter=4)
+        a = eng.add_request(p, SamplingParams(max_new_tokens=6))
+        eng.run_until_complete()
+        b = eng.add_request(p, SamplingParams(max_new_tokens=6))
+        eng.run_until_complete()
+        assert b.num_cached_prompt > 0
+        assert a.output_tokens == b.output_tokens
+
+    def test_fused_preemption_under_tiny_pool(self):
+        # Pool sized to force preemption during reservation; everything
+        # still completes with the right token counts.
+        eng = _engine(total_pages=14, decode_batch=3, decode_steps_per_iter=4)
+        seqs = [
+            eng.add_request(_prompt(10 + i, 8), SamplingParams(max_new_tokens=8))
+            for i in range(3)
+        ]
+        eng.run_until_complete()
+        for s in seqs:
+            assert s.error is None
+            assert len(s.output_tokens) == 8
