@@ -85,11 +85,12 @@ class Pod:
 
     def step_timed(self, ttfts, arrivals):
         t0 = time.perf_counter()
-        self.engine.step()
+        done = self.engine.step()
         self.clock += time.perf_counter() - t0
-        # Record first-token virtual times.
+        # Record first-token virtual times (running lanes catch prefill
+        # first-tokens; `done` catches sequences that finished this step).
         sched = self.engine.scheduler
-        for seq in list(sched.running) + self.engine.finished:
+        for seq in list(sched.running) + done:
             if seq.num_generated >= 1 and seq.seq_id not in self._first_token_seen:
                 self._first_token_seen.add(seq.seq_id)
                 if seq.seq_id in arrivals:

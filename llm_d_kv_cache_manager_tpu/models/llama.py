@@ -37,6 +37,30 @@ from ..ops import (
 )
 from ..ops.rope import RopeScalingConfig
 
+
+def _paged_attention_tp(q, kp, vp, block_tables, seq_lens, *, interpret, mesh):
+    """Decode attention, head-parallel over the ``tp`` mesh axis.
+
+    The Pallas kernel is a custom call GSPMD cannot partition, so under a
+    mesh it runs inside ``shard_map``: every tp shard holds its slice of
+    query/KV heads and computes locally — attention is embarrassingly
+    parallel over heads, so no collectives are needed here (the row-parallel
+    ``wo`` matmul immediately after carries the cross-shard reduction).
+    """
+    if mesh is None:
+        return paged_attention(q, kp, vp, block_tables, seq_lens, interpret=interpret)
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = shard_map(
+        functools.partial(paged_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(P(None, "tp"), P("tp"), P("tp"), P(), P()),
+        out_specs=P(None, "tp"),
+        check_rep=False,
+    )
+    return fn(q, kp, vp, block_tables, seq_lens)
+
 Params = dict[str, Any]
 
 
@@ -258,6 +282,7 @@ def _decode_body(
     seq_lens: jnp.ndarray,  # [b] int32 — context length INCLUDING this token
     page_size: int,
     interpret: bool,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Single decode step (traced body shared by ``decode_step`` and the
     fused ``decode_steps`` scan). Writes this token's K/V into its page
@@ -290,13 +315,14 @@ def _decode_body(
         new_k_pages.append(kp)
         new_v_pages.append(vp)
 
-        attn = paged_attention(
+        attn = _paged_attention_tp(
             q[:, 0],  # [b, n_heads, hd]
             kp,
             vp,
             block_tables,
             seq_lens,
             interpret=interpret,
+            mesh=mesh,
         )  # [b, n_heads, hd]
         h = h + (attn.reshape(b, -1) @ layer["wo"])[:, None, :]
 
@@ -312,7 +338,7 @@ def _decode_body(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "page_size", "interpret"),
+    static_argnames=("cfg", "page_size", "interpret", "mesh"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def decode_step(
@@ -327,17 +353,18 @@ def decode_step(
     *,
     page_size: int,
     interpret: bool = False,
+    mesh=None,  # tp mesh for head-parallel decode attention
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step; sampling stays with the caller (host or jit)."""
     return _decode_body(
         params, cfg, tokens, positions, k_pages, v_pages,
-        block_tables, seq_lens, page_size, interpret,
+        block_tables, seq_lens, page_size, interpret, mesh,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "page_size", "num_steps", "interpret"),
+    static_argnames=("cfg", "page_size", "num_steps", "interpret", "mesh"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def decode_steps(
@@ -357,6 +384,7 @@ def decode_steps(
     page_size: int,
     num_steps: int,
     interpret: bool = False,
+    mesh=None,  # tp mesh for head-parallel decode attention
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """``num_steps`` fused decode iterations with on-device sampling.
 
@@ -376,7 +404,7 @@ def decode_steps(
         tokens, positions, seq_lens, k_pages, v_pages = carry
         logits, k_pages, v_pages = _decode_body(
             params, cfg, tokens, positions, k_pages, v_pages,
-            block_tables, seq_lens, page_size, interpret,
+            block_tables, seq_lens, page_size, interpret, mesh,
         )
         nxt = sample_tokens(logits.astype(jnp.float32), temperature, top_k, top_p, key)
         return (nxt, positions + 1, seq_lens + 1, k_pages, v_pages), nxt

@@ -46,6 +46,9 @@ class BlockManagerConfig:
     hash_seed: str = ""
     # Emit one BlockStored per batch of freshly-filled pages.
     emit_events: bool = True
+    #: host-DRAM offload tier capacity in pages (0 = disabled). Evicted
+    #: HBM pages spill here instead of vanishing; prefix hits restore them.
+    host_pages: int = 0
 
 
 @dataclass
@@ -78,6 +81,64 @@ class BlockManager:
         # evictable cached pages (ref_count == 0), LRU order
         self._evictable: OrderedDict[int, None] = OrderedDict()  # page ids
         self._pending_events: list[Event] = []
+        # -- host-DRAM tier (SURVEY §2.3 device-tier mapping) --------------
+        # The engine attaches the actual KV movers via attach_host_pool();
+        # this class only does the tiering bookkeeping.
+        self._copy_out = None  # (device_page, host_slot) -> None
+        self._copy_in = None  # (host_slot, device_page) -> None
+        self._host_free: list[int] = list(range(config.host_pages - 1, -1, -1))
+        self._host_cached: dict[int, int] = {}  # chain_hash -> host slot
+        self._host_info: dict[int, _PageInfo] = {}  # host slot -> metadata
+        self._host_lru: OrderedDict[int, None] = OrderedDict()  # host slots
+
+    def attach_host_pool(self, copy_out, copy_in) -> None:
+        """Install the engine's device↔host page movers, enabling the
+        host-DRAM offload tier (``config.host_pages`` > 0)."""
+        self._copy_out = copy_out
+        self._copy_in = copy_in
+
+    @property
+    def num_host_cached_pages(self) -> int:
+        return len(self._host_cached)
+
+    def _host_alloc_slot(self) -> Optional[int]:
+        """Free host slot, evicting the LRU host-cached page if needed.
+        Returns None when every slot is in flight (e.g. the single slot is
+        mid-restore) — the caller then simply skips the spill."""
+        if self._host_free:
+            return self._host_free.pop()
+        if not self._host_lru:
+            return None
+        slot, _ = self._host_lru.popitem(last=False)
+        info = self._host_info.pop(slot)
+        del self._host_cached[info.chain_hash]
+        self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="host_dram"))
+        return slot
+
+    def _try_offload(self, page: int, info: _PageInfo) -> None:
+        """Spill an HBM page being recycled into the host-DRAM tier."""
+        if (
+            self._copy_out is None
+            or self.config.host_pages == 0
+            or info.chain_hash in self._host_cached
+        ):
+            return
+        slot = self._host_alloc_slot()
+        if slot is None:
+            return
+        self._copy_out(page, slot)
+        self._host_cached[info.chain_hash] = slot
+        self._host_info[slot] = info
+        self._host_lru[slot] = None
+        self._emit(
+            BlockStored(
+                block_hashes=[info.chain_hash],
+                parent_block_hash=info.parent_hash,
+                token_ids=list(info.token_ids),
+                block_size=self.config.page_size,
+                medium="host_dram",
+            )
+        )
 
     # -- introspection ------------------------------------------------------
     @property
@@ -106,12 +167,14 @@ class BlockManager:
             page = self._free.pop()
             self._pages[page] = _PageInfo(ref_count=1)
             return page
-        # Recycle the least-recently-used evictable cached page.
+        # Recycle the least-recently-used evictable cached page, spilling
+        # it to the host-DRAM tier first when one is attached.
         if self._evictable:
             page, _ = self._evictable.popitem(last=False)
             info = self._pages[page]
             assert info.ref_count == 0 and info.chain_hash is not None
             del self._cached[info.chain_hash]
+            self._try_offload(page, info)
             self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="tpu_hbm"))
             self._pages[page] = _PageInfo(ref_count=1)
             return page
@@ -136,6 +199,41 @@ class BlockManager:
                 del self._pages[page]
                 self._free.append(page)
 
+    def _try_restore(self, h: int) -> Optional[int]:
+        """Swap a host-DRAM-cached block back into an HBM page (prefix hit
+        on the offload tier). Returns the device page, or None."""
+        slot = self._host_cached.get(h)
+        if slot is None or self._copy_in is None:
+            return None
+        # Claim the slot before _pop_free_page: recycling an HBM page can
+        # itself offload into the host tier and evict the host LRU — which
+        # must never be the very slot being restored.
+        del self._host_cached[h]
+        info = self._host_info.pop(slot)
+        self._host_lru.pop(slot, None)
+        try:
+            page = self._pop_free_page()
+        except AllocationError:
+            self._host_free.append(slot)
+            return None
+        self._copy_in(slot, page)
+        self._host_free.append(slot)
+        info.ref_count = 0
+        self._pages[page] = info
+        self._cached[h] = page
+        self._evictable[page] = None  # ref 0 until the caller increfs
+        self._emit(BlockRemoved(block_hashes=[h], medium="host_dram"))
+        self._emit(
+            BlockStored(
+                block_hashes=[h],
+                parent_block_hash=info.parent_hash,
+                token_ids=list(info.token_ids),
+                block_size=self.config.page_size,
+                medium="tpu_hbm",
+            )
+        )
+        return page
+
     # -- sequence lifecycle -------------------------------------------------
     def allocate(self, seq: Sequence) -> int:
         """Allocate pages for a sequence's prompt, reusing prefix-cached
@@ -150,6 +248,8 @@ class BlockManager:
         cached_tokens = 0
         for h in hashes:
             page = self._cached.get(h)
+            if page is None:
+                page = self._try_restore(h)
             if page is None:
                 break
             self._incref(page)

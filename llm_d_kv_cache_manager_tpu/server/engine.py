@@ -15,6 +15,7 @@ lane count), so steady-state serving replays cached executables.
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -39,6 +40,19 @@ def _round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
+@jax.jit
+def _read_page(pages: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Gather one KV page [n_layers, n_kv, page_size, hd] for host offload."""
+    return jnp.take(pages, idx, axis=2)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_page(pages: jnp.ndarray, idx: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """Scatter one host page back into the pool — donated, so XLA updates
+    the pool in place instead of copying it."""
+    return pages.at[:, :, idx].set(data)
+
+
 @dataclass
 class EngineConfig:
     model: LlamaConfig = field(default_factory=lambda: llama.TINY_LLAMA)
@@ -58,6 +72,12 @@ class EngineConfig:
     prefill_ctx_bucket: int = 4
     #: run Pallas kernels in interpreter mode (CPU tests)
     interpret: bool = False
+    #: tensor-parallel degree over the ICI mesh. 1 = single-chip replica.
+    #: Params follow the Megatron-style specs in parallel/sharding.py, KV
+    #: pages shard head-parallel, and decode attention runs in shard_map;
+    #: everything else is GSPMD-partitioned by XLA. Requires
+    #: n_heads % tp == 0 and n_kv_heads % tp == 0.
+    tp: int = 1
     seed: int = 0
 
 
@@ -91,13 +111,49 @@ class Engine:
 
         if params is None:
             params = llama.init_params(jax.random.PRNGKey(config.seed), cfg)
+        self.mesh = None
+        if config.tp > 1:
+            if cfg.n_heads % config.tp or cfg.n_kv_heads % config.tp:
+                raise ValueError(
+                    f"tp={config.tp} must divide n_heads={cfg.n_heads} and "
+                    f"n_kv_heads={cfg.n_kv_heads}"
+                )
+            from ..parallel import MeshConfig, make_mesh, shard_params
+            from ..parallel.sharding import kv_pages_sharding
+
+            self.mesh = make_mesh(MeshConfig(dp=1, tp=config.tp))
+            params = shard_params(params, self.mesh, cfg)
         self.params = params
         self.k_pages, self.v_pages = llama.init_kv_pages(
             cfg, config.block_manager.total_pages, ps
         )
+        if self.mesh is not None:
+            sh = kv_pages_sharding(self.mesh)
+            self.k_pages = jax.device_put(self.k_pages, sh)
+            self.v_pages = jax.device_put(self.v_pages, sh)
+
+        # Host-DRAM offload tier: numpy slot pool + jitted page movers.
+        hp = config.block_manager.host_pages
+        if hp > 0:
+            slot_shape = (hp, cfg.n_layers, cfg.n_kv_heads, ps, cfg.hd)
+            np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
+            self._host_k = np.zeros(slot_shape, np_dtype)
+            self._host_v = np.zeros(slot_shape, np_dtype)
+            self.block_manager.attach_host_pool(self._offload_page, self._restore_page)
         self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self.finished: list[Sequence] = []
         self._step_count = 0
+
+    # -- host-DRAM tier movers ----------------------------------------------
+    def _offload_page(self, page: int, slot: int) -> None:
+        idx = jnp.asarray(page, jnp.int32)
+        self._host_k[slot] = np.asarray(_read_page(self.k_pages, idx))
+        self._host_v[slot] = np.asarray(_read_page(self.v_pages, idx))
+
+    def _restore_page(self, slot: int, page: int) -> None:
+        idx = jnp.asarray(page, jnp.int32)
+        self.k_pages = _write_page(self.k_pages, idx, jnp.asarray(self._host_k[slot]))
+        self.v_pages = _write_page(self.v_pages, idx, jnp.asarray(self._host_v[slot]))
 
     # -- public API ---------------------------------------------------------
     def add_request(
@@ -259,6 +315,7 @@ class Engine:
             jnp.asarray(seq_lens),
             page_size=self.page_size,
             interpret=self.config.interpret,
+            mesh=self.mesh,
         )
         # Sample over the full padded lane count (stable jit shape), then
         # keep the active lanes.
@@ -327,6 +384,7 @@ class Engine:
             page_size=self.page_size,
             num_steps=k,
             interpret=self.config.interpret,
+            mesh=self.mesh,
         )
         toks = np.asarray(toks)  # [lanes, k] — the one host sync
         for i, seq in enumerate(active):
