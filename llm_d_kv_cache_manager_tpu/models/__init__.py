@@ -7,6 +7,8 @@ from .llama import (
     init_kv_pages,
     LLAMA_3_8B,
     LLAMA_3_70B,
+    QWEN2_5_0_5B,
+    QWEN3_32B,
     TINY_LLAMA,
 )
 
@@ -19,5 +21,7 @@ __all__ = [
     "init_kv_pages",
     "LLAMA_3_8B",
     "LLAMA_3_70B",
+    "QWEN2_5_0_5B",
+    "QWEN3_32B",
     "TINY_LLAMA",
 ]

@@ -1,6 +1,6 @@
 """HuggingFace → native parameter conversion for Llama-family checkpoints.
 
-Maps a transformers Llama/Qwen2 state dict onto the pytree layout of
+Maps a transformers Llama/Qwen2/Qwen3 state dict onto the pytree layout of
 ``models/llama.py``. torch ``Linear`` stores ``[out, in]`` and computes
 ``x @ W.T``; our params store ``[in, out]``, so every projection transposes.
 The RoPE convention (half-split rotate) matches HF Llama, so no permutation
@@ -53,6 +53,9 @@ def load_hf_state_dict(
             layer["bq"] = jnp.asarray(get(p + "self_attn.q_proj.bias"), cfg.dtype)
             layer["bk"] = jnp.asarray(get(p + "self_attn.k_proj.bias"), cfg.dtype)
             layer["bv"] = jnp.asarray(get(p + "self_attn.v_proj.bias"), cfg.dtype)
+        if cfg.qk_norm:
+            layer["q_norm"] = jnp.asarray(get(p + "self_attn.q_norm.weight"), cfg.dtype)
+            layer["k_norm"] = jnp.asarray(get(p + "self_attn.k_norm.weight"), cfg.dtype)
         layers.append(layer)
 
     params: Params = {
@@ -101,5 +104,6 @@ def config_from_hf(hf_config) -> LlamaConfig:
         rms_norm_eps=hf_config.rms_norm_eps,
         qkv_bias=getattr(hf_config, "attention_bias", False)
         or hf_config.__class__.__name__.startswith("Qwen2"),
+        qk_norm=hf_config.__class__.__name__.startswith("Qwen3"),
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
     )

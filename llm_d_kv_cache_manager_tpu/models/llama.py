@@ -77,6 +77,7 @@ class LlamaConfig:
     rope_scaling: Optional[RopeScalingConfig] = None
     rms_norm_eps: float = 1e-5
     qkv_bias: bool = False  # Qwen2-style
+    qk_norm: bool = False  # Qwen3-style per-head RMSNorm on q/k before RoPE
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
 
@@ -95,6 +96,38 @@ LLAMA_3_70B = LlamaConfig(
     n_heads=64,
     n_kv_heads=8,
     rope_scaling=RopeScalingConfig(),
+)
+
+#: Qwen2.5-0.5B-Instruct (the reference's chat-templating benchmark model,
+#: `pkg/preprocessing/chat_completions/README.md:118`): QKV biases, tied
+#: embeddings.
+QWEN2_5_0_5B = LlamaConfig(
+    vocab_size=151_936,
+    hidden_size=896,
+    intermediate_size=4_864,
+    n_layers=24,
+    n_heads=14,
+    n_kv_heads=2,
+    rope_theta=1_000_000.0,
+    rms_norm_eps=1e-6,
+    qkv_bias=True,
+    tie_word_embeddings=True,
+)
+
+#: Qwen3-32B (the reference's 73-capacity benchmark model,
+#: `benchmarking/73-capacity/README.md:9`): per-head qk-norm, decoupled
+#: head_dim, no biases.
+QWEN3_32B = LlamaConfig(
+    vocab_size=151_936,
+    hidden_size=5_120,
+    intermediate_size=25_600,
+    n_layers=64,
+    n_heads=64,
+    n_kv_heads=8,
+    head_dim=128,
+    rope_theta=1_000_000.0,
+    rms_norm_eps=1e-6,
+    qk_norm=True,
 )
 
 #: Tiny config for tests / CPU dry-runs.
@@ -140,6 +173,9 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             layer["bq"] = jnp.zeros((n_q * hd,), cfg.dtype)
             layer["bk"] = jnp.zeros((n_kv * hd,), cfg.dtype)
             layer["bv"] = jnp.zeros((n_kv * hd,), cfg.dtype)
+        if cfg.qk_norm:
+            layer["q_norm"] = jnp.ones((hd,), cfg.dtype)
+            layer["k_norm"] = jnp.ones((hd,), cfg.dtype)
         layers.append(layer)
 
     params: Params = {
@@ -171,6 +207,9 @@ def _qkv(layer: Params, cfg: LlamaConfig, x: jnp.ndarray):
     q = q.reshape(b, s, cfg.n_heads, cfg.hd)
     k = k.reshape(b, s, cfg.n_kv_heads, cfg.hd)
     v = v.reshape(b, s, cfg.n_kv_heads, cfg.hd)
+    if cfg.qk_norm:
+        q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
     return q, k, v
 
 

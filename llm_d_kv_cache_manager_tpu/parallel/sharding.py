@@ -43,6 +43,10 @@ def _layer_specs(cfg: LlamaConfig) -> dict[str, P]:
         specs["bq"] = P("tp")
         specs["bk"] = P("tp")
         specs["bv"] = P("tp")
+    if cfg.qk_norm:
+        # Per-head-dim scale, identical across heads → replicated.
+        specs["q_norm"] = P()
+        specs["k_norm"] = P()
     return specs
 
 

@@ -214,7 +214,12 @@ class BlockManager:
         try:
             page = self._pop_free_page()
         except AllocationError:
-            self._host_free.append(slot)
+            # No HBM page available: put the block back in the host tier
+            # untouched (freeing the slot here would drop the KV copy while
+            # the index still believes this replica holds it).
+            self._host_cached[h] = slot
+            self._host_info[slot] = info
+            self._host_lru[slot] = None
             return None
         self._copy_in(slot, page)
         self._host_free.append(slot)

@@ -29,10 +29,13 @@ PS = 4
 MODEL = "tiny-llama"
 
 
-def _engine(total_pages=64, decode_batch=4, **kw):
+def _engine(total_pages=64, decode_batch=4, host_pages=0, on_events=None,
+            model=TINY_LLAMA, **kw):
     cfg = EngineConfig(
-        model=TINY_LLAMA,
-        block_manager=BlockManagerConfig(total_pages=total_pages, page_size=PS),
+        model=model,
+        block_manager=BlockManagerConfig(
+            total_pages=total_pages, page_size=PS, host_pages=host_pages
+        ),
         scheduler=SchedulerConfig(max_prefill_batch=4),
         max_model_len=64,
         decode_batch_size=decode_batch,
@@ -40,7 +43,7 @@ def _engine(total_pages=64, decode_batch=4, **kw):
         interpret=True,
         **kw,
     )
-    return Engine(cfg)
+    return Engine(cfg, on_events=on_events)
 
 
 def _prompt(seed, n):
@@ -290,6 +293,42 @@ class TestBlockManagerUnit:
         s3 = Sequence(prompt_tokens=list(range(14 * PS)))
         bm.allocate(s3)
 
+    def test_failed_restore_keeps_host_block(self):
+        # Regression: a prefix hit on the host tier while every HBM page is
+        # pinned must leave the host-cached block intact (and emit no
+        # events), so a later retry can still restore it.
+        captured = []
+        bm = BlockManager(
+            BlockManagerConfig(total_pages=3, page_size=PS, host_pages=4),
+            on_events=captured.extend,
+        )
+        host_store = {}
+        bm.attach_host_pool(
+            copy_out=lambda page, slot: host_store.__setitem__(slot, page),
+            copy_in=lambda slot, page: None,
+        )
+        # Fill + register A's 2 pages, free it, then pin both pages with B —
+        # recycling A's pages spills them into the host tier.
+        a = Sequence(prompt_tokens=list(range(2 * PS)))
+        bm.allocate(a)
+        a.num_computed = 2 * PS
+        bm.register_full_pages(a)
+        bm.free_sequence(a)
+        b = Sequence(prompt_tokens=list(range(100, 100 + 2 * PS)))
+        bm.allocate(b)
+        assert bm.num_host_cached_pages == 2 and bm.num_free == 0
+
+        captured.clear()
+        c = Sequence(prompt_tokens=list(range(2 * PS)))  # same prefix as A
+        with pytest.raises(AllocationError):
+            bm.allocate(c)
+        assert bm.num_host_cached_pages == 2  # host copy survived
+        assert captured == []  # no phantom BlockRemoved/BlockStored
+        # Once B releases its pages the restore succeeds.
+        bm.free_sequence(b)
+        c2 = Sequence(prompt_tokens=list(range(2 * PS)))
+        assert bm.allocate(c2) == PS  # first block restored from host tier
+
 
 class TestFusedDecode:
     """decode_steps_per_iter > 1: device-resident multi-token decode."""
@@ -386,14 +425,25 @@ class TestTensorParallelServing:
         with _pytest.raises(ValueError):
             _engine(tp=3)
 
+    def test_tp_qk_norm_model_serves(self):
+        # Regression: qk-norm (Qwen3-style) params must have sharding specs,
+        # and TP output must match single-chip.
+        import dataclasses
+
+        cfg = dataclasses.replace(TINY_LLAMA, qk_norm=True)
+        p = _prompt(35, 10)
+        outs = []
+        for tp in (1, 2):
+            eng = _engine(tp=tp, model=cfg)
+            s = eng.add_request(p, SamplingParams(max_new_tokens=5))
+            eng.run_until_complete()
+            outs.append(s.output_tokens)
+        assert outs[0] == outs[1]
+
 
 class TestHostDramOffloadTier:
     """BlockManagerConfig.host_pages > 0: evicted HBM pages spill to host
     DRAM with medium-tagged events; prefix hits restore them."""
-
-    def _events(self):
-        captured = []
-        return captured, captured.extend
 
     def test_restored_pages_preserve_kv_exactly(self):
         # Reference: pool big enough that nothing is ever evicted.
@@ -407,15 +457,7 @@ class TestHostDramOffloadTier:
 
         # Tiered: pool so small that prompt A's pages are evicted (to host)
         # by B and C; the repeat of A must restore them and match exactly.
-        import dataclasses
-        cfg = _engine(total_pages=12).config
-        cfg = dataclasses.replace(
-            cfg, block_manager=dataclasses.replace(
-                cfg.block_manager, total_pages=12, host_pages=32
-            )
-        )
-        from llm_d_kv_cache_manager_tpu.server import Engine
-        eng = Engine(cfg)
+        eng = _engine(total_pages=12, host_pages=32)
         outs = []
         for p in prompts + [prompts[0]]:
             s = eng.add_request(p, SamplingParams(max_new_tokens=5))
@@ -425,16 +467,8 @@ class TestHostDramOffloadTier:
         assert s.num_cached_prompt > 0  # repeat of A hit the restored pages
 
     def test_offload_and_restore_emit_medium_tagged_events(self):
-        import dataclasses
         captured = []
-        cfg = _engine(total_pages=12).config
-        cfg = dataclasses.replace(
-            cfg, block_manager=dataclasses.replace(
-                cfg.block_manager, total_pages=12, host_pages=32
-            )
-        )
-        from llm_d_kv_cache_manager_tpu.server import Engine
-        eng = Engine(cfg, on_events=captured.extend)
+        eng = _engine(total_pages=12, host_pages=32, on_events=captured.extend)
         a = _prompt(50, 16)
         for p in (a, _prompt(51, 16), _prompt(52, 16), a):
             eng.add_request(p, SamplingParams(max_new_tokens=5))
@@ -443,21 +477,18 @@ class TestHostDramOffloadTier:
         assert ("BlockStored", "host_dram") in media  # offload
         assert ("BlockRemoved", "host_dram") in media  # restore (swap back)
         assert ("BlockStored", "tpu_hbm") in media
-        assert eng.block_manager.num_host_cached_pages >= 0
+        # The restore swapped A's pages back to HBM, so the host tier must
+        # have fewer cached pages than were offloaded in total.
+        stored_host = sum(
+            1 for name, m in media if (name, m) == ("BlockStored", "host_dram")
+        )
+        assert eng.block_manager.num_host_cached_pages < stored_host
 
     def test_host_pool_lru_eviction(self):
         # Host tier smaller than the spill volume: oldest host pages get
         # BlockRemoved(host_dram) and the engine keeps working.
-        import dataclasses
         captured = []
-        cfg = _engine(total_pages=12).config
-        cfg = dataclasses.replace(
-            cfg, block_manager=dataclasses.replace(
-                cfg.block_manager, total_pages=12, host_pages=4
-            )
-        )
-        from llm_d_kv_cache_manager_tpu.server import Engine
-        eng = Engine(cfg, on_events=captured.extend)
+        eng = _engine(total_pages=12, host_pages=4, on_events=captured.extend)
         for i in range(6):
             eng.add_request(_prompt(60 + i, 16), SamplingParams(max_new_tokens=4))
             eng.run_until_complete()
@@ -471,15 +502,7 @@ class TestHostDramOffloadTier:
     def test_single_host_slot_mid_restore_does_not_crash(self):
         # Regression: with host_pages=1, restoring the only host slot while
         # HBM recycling wants to spill must skip the spill, not KeyError.
-        import dataclasses
-        cfg = _engine(total_pages=3).config
-        cfg = dataclasses.replace(
-            cfg, block_manager=dataclasses.replace(
-                cfg.block_manager, total_pages=3, host_pages=1
-            )
-        )
-        from llm_d_kv_cache_manager_tpu.server import Engine
-        eng = Engine(cfg)
+        eng = _engine(total_pages=3, host_pages=1)
         a = _prompt(70, 3)
         eng.add_request(a, SamplingParams(max_new_tokens=2))
         eng.run_until_complete()
