@@ -1,6 +1,6 @@
 """HuggingFace → native parameter conversion for Llama-family checkpoints.
 
-Maps a transformers Llama/Qwen2/Qwen3 state dict onto the pytree layout of
+Maps a transformers Llama/Qwen2/Qwen3/Mixtral state dict onto the pytree layout of
 ``models/llama.py``. torch ``Linear`` stores ``[out, in]`` and computes
 ``x @ W.T``; our params store ``[in, out]``, so every projection transposes.
 The RoPE convention (half-split rotate) matches HF Llama, so no permutation
@@ -45,10 +45,26 @@ def load_hf_state_dict(
             "wv": linear(p + "self_attn.v_proj.weight"),
             "wo": linear(p + "self_attn.o_proj.weight"),
             "mlp_norm": jnp.asarray(get(p + "post_attention_layernorm.weight"), cfg.dtype),
-            "w_gate": linear(p + "mlp.gate_proj.weight"),
-            "w_up": linear(p + "mlp.up_proj.weight"),
-            "w_down": linear(p + "mlp.down_proj.weight"),
         }
+        if cfg.n_experts:
+            # Mixtral block_sparse_moe: gate = router [E, d]; expert j's
+            # w1/w3/w2 = gate/up/down projections. Stacked to [E, d, f] /
+            # [E, f, d] for the masked-dense expert einsum.
+            moe = p + "block_sparse_moe."
+            layer["router"] = linear(moe + "gate.weight")
+            layer["w_gate"] = jnp.stack(
+                [linear(f"{moe}experts.{j}.w1.weight") for j in range(cfg.n_experts)]
+            )
+            layer["w_up"] = jnp.stack(
+                [linear(f"{moe}experts.{j}.w3.weight") for j in range(cfg.n_experts)]
+            )
+            layer["w_down"] = jnp.stack(
+                [linear(f"{moe}experts.{j}.w2.weight") for j in range(cfg.n_experts)]
+            )
+        else:
+            layer["w_gate"] = linear(p + "mlp.gate_proj.weight")
+            layer["w_up"] = linear(p + "mlp.up_proj.weight")
+            layer["w_down"] = linear(p + "mlp.down_proj.weight")
         if cfg.qkv_bias:
             layer["bq"] = jnp.asarray(get(p + "self_attn.q_proj.bias"), cfg.dtype)
             layer["bk"] = jnp.asarray(get(p + "self_attn.k_proj.bias"), cfg.dtype)
@@ -106,4 +122,6 @@ def config_from_hf(hf_config) -> LlamaConfig:
         or hf_config.__class__.__name__.startswith("Qwen2"),
         qk_norm=hf_config.__class__.__name__.startswith("Qwen3"),
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        n_experts=getattr(hf_config, "num_local_experts", 0),
+        n_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
     )

@@ -15,7 +15,10 @@ Design (TPU-first, not a torch translation):
   float32.
 
 The architecture covers Llama 2/3 and Qwen-style GQA decoders (RMSNorm,
-RoPE, SwiGLU, optional QKV biases, optional tied embeddings).
+RoPE, SwiGLU, optional QKV biases, optional tied embeddings) and
+Mixtral-style sparse-MoE decoders (``n_experts > 0``: softmax-top-k routed
+SwiGLU experts replacing the dense FFN; attention/KV paths are identical,
+so paged serving and prefix-cache routing work unchanged).
 """
 
 from __future__ import annotations
@@ -79,6 +82,8 @@ class LlamaConfig:
     qkv_bias: bool = False  # Qwen2-style
     qk_norm: bool = False  # Qwen3-style per-head RMSNorm on q/k before RoPE
     tie_word_embeddings: bool = False
+    n_experts: int = 0  # Mixtral-style MoE FFN when > 0
+    n_experts_per_tok: int = 2
     dtype: Any = jnp.bfloat16
 
     @property
@@ -130,6 +135,20 @@ QWEN3_32B = LlamaConfig(
     qk_norm=True,
 )
 
+#: Mixtral-8x7B-v0.1 (`BASELINE.json` configs[4]: multi-host MoE serving):
+#: Llama-shaped attention (GQA 32/8) with 8 top-2-routed SwiGLU experts.
+MIXTRAL_8X7B = LlamaConfig(
+    vocab_size=32_000,
+    hidden_size=4_096,
+    intermediate_size=14_336,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    rope_theta=1_000_000.0,
+    n_experts=8,
+    n_experts_per_tok=2,
+)
+
 #: Tiny config for tests / CPU dry-runs.
 TINY_LLAMA = LlamaConfig(
     vocab_size=256,
@@ -139,6 +158,20 @@ TINY_LLAMA = LlamaConfig(
     n_heads=4,
     n_kv_heads=2,
     rope_theta=10_000.0,
+    dtype=jnp.float32,
+)
+
+#: Tiny MoE config (Mixtral-shaped) for tests / CPU dry-runs.
+TINY_MOE = LlamaConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=96,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    rope_theta=10_000.0,
+    n_experts=4,
+    n_experts_per_tok=2,
     dtype=jnp.float32,
 )
 
@@ -157,7 +190,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
     keys = jax.random.split(rng, cfg.n_layers + 2)
     layers = []
     for i in range(cfg.n_layers):
-        k = jax.random.split(keys[i], 7)
+        k = jax.random.split(keys[i], 8)
         layer = {
             "attn_norm": jnp.ones((d,), cfg.dtype),
             "wq": dense(k[0], (d, n_q * hd), d),
@@ -165,10 +198,17 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             "wv": dense(k[2], (d, n_kv * hd), d),
             "wo": dense(k[3], (n_q * hd, d), n_q * hd),
             "mlp_norm": jnp.ones((d,), cfg.dtype),
-            "w_gate": dense(k[4], (d, inter), d),
-            "w_up": dense(k[5], (d, inter), d),
-            "w_down": dense(k[6], (inter, d), inter),
         }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            layer["router"] = dense(k[7], (d, e), d)
+            layer["w_gate"] = dense(k[4], (e, d, inter), d)
+            layer["w_up"] = dense(k[5], (e, d, inter), d)
+            layer["w_down"] = dense(k[6], (e, inter, d), inter)
+        else:
+            layer["w_gate"] = dense(k[4], (d, inter), d)
+            layer["w_up"] = dense(k[5], (d, inter), d)
+            layer["w_down"] = dense(k[6], (inter, d), inter)
         if cfg.qkv_bias:
             layer["bq"] = jnp.zeros((n_q * hd,), cfg.dtype)
             layer["bk"] = jnp.zeros((n_kv * hd,), cfg.dtype)
@@ -213,7 +253,39 @@ def _qkv(layer: Params, cfg: LlamaConfig, x: jnp.ndarray):
     return q, k, v
 
 
-def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Mixtral-style sparse-MoE SwiGLU FFN.
+
+    Gating matches HF Mixtral (`MixtralSparseMoeBlock`): softmax over ALL
+    expert logits, take top-k, renormalize the survivors. The combine is a
+    masked-dense einsum over stacked expert weights ``[E, d, f]`` — every
+    expert sees every token, with non-selected contributions zeroed by the
+    gate. That trades FLOPs for TPU-native static shapes (no gather/sort/
+    ragged dispatch XLA can't tile), and under expert-parallel sharding
+    (``E`` on the ``tp``/ep axis, `parallel/sharding.py`) each device only
+    computes its LOCAL experts for the replicated activations; the final
+    contraction over ``E`` becomes an XLA-inserted psum over ICI. With
+    E == tp (Mixtral 8x7B on a v5e-8 slice) per-device work is exactly one
+    expert per token.
+    """
+    router_logits = (x @ layer["router"]).astype(jnp.float32)  # [b, s, E]
+    weights = jax.nn.softmax(router_logits, axis=-1)
+    topv, topi = jax.lax.top_k(weights, cfg.n_experts_per_tok)  # [b, s, k]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # Scatter the renormalized top-k gates back to a dense [b, s, E] mask.
+    gates = jnp.sum(
+        jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32) * topv[..., None],
+        axis=-2,
+    )
+    gate = jax.nn.silu(jnp.einsum("bsd,edf->ebsf", x, layer["w_gate"]).astype(jnp.float32))
+    up = jnp.einsum("bsd,edf->ebsf", x, layer["w_up"]).astype(jnp.float32)
+    act = (gate * up).astype(x.dtype)
+    return jnp.einsum("ebsf,efd,bse->bsd", act, layer["w_down"], gates.astype(x.dtype))
+
+
+def _mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.n_experts:
+        return _moe_mlp(layer, cfg, x)
     gate = jax.nn.silu((x @ layer["w_gate"]).astype(jnp.float32))
     up = (x @ layer["w_up"]).astype(jnp.float32)
     return ((gate * up).astype(x.dtype)) @ layer["w_down"]
@@ -292,7 +364,7 @@ def prefill(
         h = h + attn.reshape(b, s, -1) @ layer["wo"]
 
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, x)
+        h = h + _mlp(layer, cfg, x)
 
         new_k_pages.append(
             _scatter_kv_pages(k_pages[li], k.astype(k_pages.dtype), page_ids, slot_ids, valid)
@@ -366,7 +438,7 @@ def _decode_body(
         h = h + (attn.reshape(b, -1) @ layer["wo"])[:, None, :]
 
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        h = h + _mlp(layer, x)
+        h = h + _mlp(layer, cfg, x)
 
     return (
         _logits(params, cfg, h)[:, 0],

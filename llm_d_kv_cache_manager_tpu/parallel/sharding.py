@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.llama import LlamaConfig, Params
 
 
-def _layer_specs(cfg: LlamaConfig) -> dict[str, P]:
+def _layer_specs(cfg: LlamaConfig, tp: int = 1) -> dict[str, P]:
     specs = {
         "attn_norm": P(),
         "wq": P(None, "tp"),
@@ -39,6 +39,20 @@ def _layer_specs(cfg: LlamaConfig) -> dict[str, P]:
         "w_up": P(None, "tp"),
         "w_down": P("tp", None),
     }
+    if cfg.n_experts:
+        # MoE FFN: expert-parallel when the expert count divides the tp
+        # axis (each device holds E/tp whole experts; the combine's
+        # contraction over E becomes a psum over ICI), else fall back to
+        # Megatron-style sharding of the expert-intermediate dim.
+        specs["router"] = P()
+        if cfg.n_experts % tp == 0:
+            specs["w_gate"] = P("tp", None, None)
+            specs["w_up"] = P("tp", None, None)
+            specs["w_down"] = P("tp", None, None)
+        else:
+            specs["w_gate"] = P(None, None, "tp")
+            specs["w_up"] = P(None, None, "tp")
+            specs["w_down"] = P(None, "tp", None)
     if cfg.qkv_bias:
         specs["bq"] = P("tp")
         specs["bk"] = P("tp")
@@ -50,12 +64,12 @@ def _layer_specs(cfg: LlamaConfig) -> dict[str, P]:
     return specs
 
 
-def param_specs(cfg: LlamaConfig) -> dict[str, Any]:
+def param_specs(cfg: LlamaConfig, tp: int = 1) -> dict[str, Any]:
     """PartitionSpec pytree matching ``init_params``' structure."""
     specs: dict[str, Any] = {
         "embed": P("tp", None),  # vocab-sharded; gather rides ICI
         "final_norm": P(),
-        "layers": [_layer_specs(cfg) for _ in range(cfg.n_layers)],
+        "layers": [_layer_specs(cfg, tp) for _ in range(cfg.n_layers)],
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
@@ -65,7 +79,7 @@ def param_specs(cfg: LlamaConfig) -> dict[str, Any]:
 def param_shardings(mesh: Mesh, cfg: LlamaConfig):
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(cfg),
+        param_specs(cfg, tp=mesh.shape.get("tp", 1)),
         is_leaf=lambda x: isinstance(x, P),
     )
 

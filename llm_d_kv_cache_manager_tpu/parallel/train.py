@@ -37,21 +37,13 @@ def _forward_logits(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jn
     h = params["embed"][tokens]
     for layer in params["layers"]:
         x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
-        q = (x @ layer["wq"]).reshape(b, s, cfg.n_heads, cfg.hd)
-        k = (x @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.hd)
-        v = (x @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.hd)
-        if cfg.qkv_bias:
-            q = q + layer["bq"].reshape(cfg.n_heads, cfg.hd)
-            k = k + layer["bk"].reshape(cfg.n_kv_heads, cfg.hd)
-            v = v + layer["bv"].reshape(cfg.n_kv_heads, cfg.hd)
+        q, k, v = llama._qkv(layer, cfg, x)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         attn = causal_prefill_attention(q, k, v)
         h = h + attn.reshape(b, s, -1) @ layer["wo"]
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu((x @ layer["w_gate"]).astype(jnp.float32))
-        up = (x @ layer["w_up"]).astype(jnp.float32)
-        h = h + ((gate * up).astype(h.dtype)) @ layer["w_down"]
+        h = h + llama._mlp(layer, cfg, x)
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     return (h @ head).astype(jnp.float32)

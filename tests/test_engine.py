@@ -511,3 +511,35 @@ class TestHostDramOffloadTier:
         s = eng.add_request(a, SamplingParams(max_new_tokens=2))
         eng.run_until_complete()
         assert s.error is None and len(s.output_tokens) == 2
+
+
+class TestMoEServing:
+    """Mixtral-style MoE model through the full engine: continuous batching,
+    prefix cache, and expert-parallel TP must all preserve greedy output."""
+
+    def test_moe_greedy_matches_single_chip(self):
+        from llm_d_kv_cache_manager_tpu.models import TINY_MOE
+
+        prompts = [_prompt(60 + i, 10 + i) for i in range(2)]
+        outs = []
+        for tp in (1, 2):
+            eng = _engine(tp=tp, model=TINY_MOE)
+            seqs = [
+                eng.add_request(p, SamplingParams(max_new_tokens=5))
+                for p in prompts
+            ]
+            eng.run_until_complete()
+            outs.append([s.output_tokens for s in seqs])
+        assert outs[0] == outs[1]
+
+    def test_moe_prefix_cache_hit(self):
+        from llm_d_kv_cache_manager_tpu.models import TINY_MOE
+
+        p = _prompt(70, 16)
+        eng = _engine(model=TINY_MOE)
+        a = eng.add_request(p, SamplingParams(max_new_tokens=5))
+        eng.run_until_complete()
+        b = eng.add_request(p, SamplingParams(max_new_tokens=5))
+        eng.run_until_complete()
+        assert b.num_cached_prompt > 0
+        assert a.output_tokens == b.output_tokens
