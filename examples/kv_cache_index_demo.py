@@ -1,0 +1,69 @@
+"""KV-cache index demo: score → manually add known block hashes → score.
+
+Mirrors the reference demo (``examples/kv_cache_index/main.go:113-149`` with
+the embedded fixture ``examples/testdata/data.go:21-33``): build a real
+``KVCacheIndexer``, score a prompt against an empty index (expect no hits),
+``Add`` the prompt's own block hashes for a pod as if that pod had cached the
+prefix, then score again and watch the hit depth appear.
+
+Run: ``python examples/kv_cache_index_demo.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry, TokenProcessorConfig
+from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
+
+MODEL = "meta-llama/Llama-3.1-8B-Instruct"
+POD = "tpu-pod-1"
+
+# Embedded fixture, like the reference's testdata/data.go prompt.
+PROMPT = (
+    "lorem ipsum dolor sit amet, consectetur adipiscing elit, sed do eiusmod "
+    "tempor incididunt ut labore et dolore magna aliqua. Ut enim ad minim "
+    "veniam, quis nostrud exercitation ullamco laboris nisi ut aliquip ex ea "
+    "commodo consequat."
+)
+
+
+class CharTokenizer(Tokenizer):
+    """Offline stand-in for the HF tokenizer (demo runs with no network)."""
+
+    def encode(self, prompt, model_name):
+        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
+
+
+def main() -> int:
+    indexer = KVCacheIndexer(
+        KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=16)),
+        tokenizer=CharTokenizer(),
+    )
+    indexer.run()
+    try:
+        scores = indexer.get_pod_scores(PROMPT, MODEL)
+        print(f"before add: scores={scores}")
+        assert scores == {}, "expected an empty index to produce no scores"
+
+        # Compute the prompt's chained block keys (the same keys the serving
+        # engine would emit in BlockStored events) and add them for POD.
+        tokens = [ord(c) for c in PROMPT]
+        keys = indexer.token_processor.tokens_to_kv_block_keys(tokens, MODEL)
+        print(f"adding {len(keys)} block keys for pod {POD!r}")
+        print(f"  first hashes: {[hex(k.chunk_hash) for k in keys[:4]]}")
+        indexer.kv_block_index.add(keys, [PodEntry(POD)])
+
+        scores = indexer.get_pod_scores(PROMPT, MODEL)
+        print(f"after add: scores={scores}")
+        assert scores == {POD: len(keys)}
+        print("OK")
+        return 0
+    finally:
+        indexer.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

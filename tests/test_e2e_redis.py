@@ -1,0 +1,161 @@
+"""Full-pipeline e2e over the distributed (redis) index backend.
+
+Mirrors the reference's redis-mock e2e suite (``tests/e2e/redis_mock/
+e2e_suite_test.go:55-77`` + ``e2e_test.go``): a real ``KVCacheIndexer``
+wired to a ``RedisIndex`` over an in-process fake redis (their miniredis),
+exercising the write path (event pool → index) and the read path
+(tokenize → hash → lookup → score) together across the "network" boundary.
+"""
+
+import time
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.indexer import KVCacheIndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    DeviceTier,
+    PodEntry,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import (
+    RedisIndex,
+    RedisIndexConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    BlockStored,
+    BlockRemoved,
+    EventBatch,
+    KVEventsPool,
+    KVEventsPoolConfig,
+    Message,
+)
+from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
+from llm_d_kv_cache_manager_tpu.tokenization.pool import TokenizationPoolConfig
+
+from fake_redis import FakeRedis
+
+MODEL = "e2e-model"
+BLOCK = 4
+
+
+class CharTokenizer(Tokenizer):
+    def encode(self, prompt, model_name):
+        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
+
+
+@pytest.fixture
+def indexer():
+    cfg = KVCacheIndexerConfig(
+        token_processor=TokenProcessorConfig(block_size=BLOCK),
+        tokenization_pool=TokenizationPoolConfig(workers_count=2),
+    )
+    redis_index = RedisIndex(RedisIndexConfig(client=FakeRedis()))
+    ix = KVCacheIndexer(cfg, index=redis_index, tokenizer=CharTokenizer())
+    ix.run()
+    yield ix
+    ix.shutdown()
+
+
+def _keys(indexer, prompt):
+    return indexer.token_processor.tokens_to_kv_block_keys(
+        [ord(c) for c in prompt], MODEL
+    )
+
+
+class TestRedisBackedReadPath:
+    def test_cache_miss_then_hit(self, indexer):
+        prompt = "abcdefghijklmnop"  # 4 blocks
+        assert indexer.get_pod_scores(prompt, MODEL) == {}
+        indexer.kv_block_index.add(_keys(indexer, prompt), [PodEntry("pod-1")])
+        assert indexer.get_pod_scores(prompt, MODEL) == {"pod-1": 4}
+
+    def test_prefix_reduction_and_expansion(self, indexer):
+        prompt = "abcdefghijklmnop"
+        keys = _keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1")])
+        for key in keys[2:]:
+            indexer.kv_block_index.evict(key, [PodEntry("pod-1")])
+        assert indexer.get_pod_scores(prompt, MODEL) == {"pod-1": 2}
+        # expansion: longer prompt scores only the cached prefix depth
+        assert indexer.get_pod_scores(prompt + "qrstuvwx", MODEL) == {"pod-1": 2}
+
+    def test_long_prefix(self, indexer):
+        prompt = ("the quick brown fox jumps over the lazy dog " * 128)[:4504]
+        keys = _keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1")])
+        assert indexer.get_pod_scores(prompt, MODEL) == {"pod-1": len(keys)}
+
+    def test_tier_preserved_in_redis_fields(self, indexer):
+        """Fields are ``pod@tier`` (reference ``redis.go:150-157``); lookup
+        strips the tier and returns pod ids."""
+        prompt = "abcdefgh"
+        keys = _keys(indexer, prompt)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-1", DeviceTier.HOST_DRAM)])
+        got = indexer.kv_block_index.lookup(keys, set())
+        for key in keys:
+            assert got[key] == ["pod-1"]
+        raw_fields = indexer.kv_block_index._client.hkeys(str(keys[0]))
+        assert [
+            f.decode() if isinstance(f, bytes) else f for f in raw_fields
+        ] == ["pod-1@host_dram"]
+
+
+class TestRedisBackedWritePath:
+    def test_events_flow_into_redis_index(self, indexer):
+        """BlockStored/BlockRemoved events (msgpack, through the sharded pool)
+        land in the shared redis index and change scores (SURVEY §3.2/§3.5)."""
+        pool = KVEventsPool(indexer.kv_block_index, KVEventsPoolConfig(concurrency=2))
+        pool.start()
+        try:
+            prompt = "abcdefghijklmnop"
+            hashes = [k.chunk_hash for k in _keys(indexer, prompt)]
+            batch = EventBatch(
+                ts=time.time(),
+                events=[
+                    BlockStored(
+                        block_hashes=hashes,
+                        parent_block_hash=None,
+                        token_ids=[ord(c) for c in prompt],
+                        block_size=BLOCK,
+                        lora_id=None,
+                    )
+                ],
+            )
+            pool.add_task(
+                Message(
+                    topic=f"kv@tpu-pod-7@{MODEL}",
+                    pod_identifier="tpu-pod-7",
+                    model_name=MODEL,
+                    payload=batch.to_payload(),
+                    seq=1,
+                )
+            )
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 4}:
+                    break
+                time.sleep(0.01)
+            assert indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 4}
+
+            removal = EventBatch(
+                ts=time.time(),
+                events=[BlockRemoved(block_hashes=hashes[2:])],
+            )
+            pool.add_task(
+                Message(
+                    topic=f"kv@tpu-pod-7@{MODEL}",
+                    pod_identifier="tpu-pod-7",
+                    model_name=MODEL,
+                    payload=removal.to_payload(),
+                    seq=2,
+                )
+            )
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 2}:
+                    break
+                time.sleep(0.01)
+            assert indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 2}
+        finally:
+            pool.shutdown()
