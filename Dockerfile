@@ -1,0 +1,19 @@
+# Image for both binaries: the scoring service (server.api) and the TPU pod
+# server (server.serve). Select via the container command.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ libzmq3-dev && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY requirements.txt .
+RUN pip install --no-cache-dir -r requirements.txt
+
+COPY llm_d_kv_cache_manager_tpu/ llm_d_kv_cache_manager_tpu/
+# Build the C++ chained-hash kernel (pure-Python fallback exists, but the
+# native kernel is the hot read-path op).
+RUN python -m llm_d_kv_cache_manager_tpu.native.build
+
+EXPOSE 8080 5557 8000
+CMD ["python", "-m", "llm_d_kv_cache_manager_tpu.server.api"]

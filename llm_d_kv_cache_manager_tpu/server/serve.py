@@ -1,0 +1,388 @@
+"""TPU pod serving binary: the in-tree analogue of a vLLM pod.
+
+The reference deploys external vLLM pods configured to publish KV events
+(``vllm-setup-helm/templates/deployment.yaml:80-81``: ``--kv-events-config
+publisher=zmq, topic kv@<pod>@<model>``, ``--prefix-caching-hash-algo
+sha256_cbor_64bit``). In this framework the serving engine is in-tree, so
+this module is that pod: a continuous-batching ``Engine`` (Pallas paged
+attention, prefix-caching block manager) wrapped in
+
+- a background engine loop thread,
+- a ZMQ KV-event publisher wired to the block manager's alloc/evict
+  transitions (``kv@<pod>@<model>`` topic, msgpack array-struct batches,
+  big-endian seq — the exact contract the indexer's subscriber expects),
+- an OpenAI-style HTTP surface: ``POST /v1/completions``, ``GET /healthz``,
+  ``GET /stats``.
+
+Config comes from env vars mirroring the reference's online service
+(``examples/kv_events/online/main.go:162-209``): ``MODEL_NAME``,
+``POD_IDENTIFIER``, ``ZMQ_ENDPOINT``, ``BLOCK_SIZE``, ``PYTHONHASHSEED``,
+``HTTP_PORT``, plus engine sizing (``TOTAL_PAGES``, ``HOST_PAGES``, ``TP``,
+``MAX_MODEL_LEN``, ``DP_RANK``).
+
+Run: ``python -m llm_d_kv_cache_manager_tpu.server.serve``
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import uuid
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..kvcache.kvevents import ZMQPublisher, ZMQPublisherConfig
+from ..models import LlamaConfig
+from ..utils import get_logger
+from .engine import Engine, EngineConfig
+from .block_manager import BlockManagerConfig
+from .sequence import SamplingParams, Sequence
+
+log = get_logger("server.serve")
+
+
+@dataclass
+class PodServerConfig:
+    model_name: str = "tiny-llama"
+    pod_identifier: str = field(default_factory=socket.gethostname)
+    #: indexer-side SUB socket to connect the PUB to (SUB binds, we connect —
+    #: reference zmq_subscriber.go:90 / publisher.go:59).
+    zmq_endpoint: str = "tcp://localhost:5557"
+    publish_events: bool = True
+    data_parallel_rank: Optional[int] = None
+    http_port: int = 8000
+    engine: EngineConfig = field(default_factory=EngineConfig)
+
+    @classmethod
+    def from_env(cls) -> "PodServerConfig":
+        cfg = cls()
+        cfg.model_name = os.environ.get("MODEL_NAME", cfg.model_name)
+        cfg.pod_identifier = os.environ.get("POD_IDENTIFIER", cfg.pod_identifier)
+        cfg.zmq_endpoint = os.environ.get("ZMQ_ENDPOINT", cfg.zmq_endpoint)
+        cfg.publish_events = os.environ.get("PUBLISH_EVENTS", "1") not in ("0", "false")
+        if "DP_RANK" in os.environ:
+            cfg.data_parallel_rank = int(os.environ["DP_RANK"])
+        cfg.http_port = int(os.environ.get("HTTP_PORT", cfg.http_port))
+
+        eng = cfg.engine
+        eng.block_manager = BlockManagerConfig(
+            total_pages=int(os.environ.get("TOTAL_PAGES", 1024)),
+            page_size=int(os.environ.get("BLOCK_SIZE", 16)),
+            # Reference parity: the engine's hash seed must match the
+            # indexer's (token_processor.go:37-40).
+            hash_seed=os.environ.get("PYTHONHASHSEED", ""),
+            host_pages=int(os.environ.get("HOST_PAGES", 0)),
+        )
+        eng.max_model_len = int(os.environ.get("MAX_MODEL_LEN", eng.max_model_len))
+        eng.tp = int(os.environ.get("TP", eng.tp))
+        eng.decode_batch_size = int(
+            os.environ.get("DECODE_BATCH_SIZE", eng.decode_batch_size)
+        )
+        eng.decode_steps_per_iter = int(
+            os.environ.get("DECODE_STEPS_PER_ITER", eng.decode_steps_per_iter)
+        )
+        # CPU smoke runs (Pallas interpreter mode); never set on real TPU.
+        eng.interpret = os.environ.get("INTERPRET", "0") not in ("0", "false")
+        return cfg
+
+
+class PodServer:
+    """Engine + event publisher + HTTP front end for one TPU serving pod."""
+
+    def __init__(
+        self,
+        config: Optional[PodServerConfig] = None,
+        *,
+        engine: Optional[Engine] = None,
+        tokenizer=None,
+        publisher: Optional[ZMQPublisher] = None,
+    ):
+        self.config = config or PodServerConfig()
+        self._tokenizer = tokenizer
+
+        self._publisher = publisher
+        if self._publisher is None and self.config.publish_events:
+            self._publisher = ZMQPublisher(
+                ZMQPublisherConfig(
+                    endpoint=self.config.zmq_endpoint,
+                    pod_identifier=self.config.pod_identifier,
+                    model_name=self.config.model_name,
+                    data_parallel_rank=self.config.data_parallel_rank,
+                )
+            )
+
+        on_events = self._publisher.publish if self._publisher is not None else None
+        self.engine = engine or Engine(self.config.engine, on_events=on_events)
+        if engine is not None and on_events is not None:
+            # Injected engine: attach the publisher to its block manager.
+            self.engine.block_manager.on_events = on_events
+
+        #: staging guard — HTTP threads only touch the staging deque; the
+        #: engine itself is single-threaded (loop thread only), so steps run
+        #: without any lock and enqueueing never waits on device compute.
+        self._mu = threading.Lock()
+        self._work = threading.Condition(self._mu)
+        self._staging: deque[tuple[list[int], Optional[SamplingParams], Future]] = deque()
+        self._futures: dict[int, Future] = {}  # loop-thread-only
+        self._running = False
+        self._failed: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        with self._mu:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._engine_loop, name="engine-loop", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        with self._work:
+            self._running = False
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        self._fail_outstanding(RuntimeError("pod server shut down"))
+        if self._publisher is not None:
+            self._publisher.close()
+
+    def _fail_outstanding(self, exc: BaseException) -> None:
+        with self._mu:
+            staged = list(self._staging)
+            self._staging.clear()
+        for _, _, fut in staged:
+            if not fut.done():
+                fut.set_exception(exc)
+        for fut in list(self._futures.values()):
+            if not fut.done():
+                fut.set_exception(exc)
+        self._futures.clear()
+
+    def _engine_loop(self) -> None:
+        try:
+            while True:
+                with self._work:
+                    while self._running and not (
+                        self._staging or self.engine.has_work
+                    ):
+                        self._work.wait(timeout=0.1)
+                    if not self._running:
+                        return
+                    staged = list(self._staging)
+                    self._staging.clear()
+                # Engine state is owned by this thread — no lock held while
+                # admitting or stepping (device compute can take a while).
+                for tokens, sampling, fut in staged:
+                    try:
+                        seq = self.engine.add_request(
+                            tokens, sampling, request_id=str(uuid.uuid4())
+                        )
+                    except ValueError as e:
+                        fut.set_exception(e)
+                        continue
+                    self._futures[seq.seq_id] = fut
+                if self.engine.has_work:
+                    finished = self.engine.step()
+                    for seq in finished:
+                        fut = self._futures.pop(seq.seq_id, None)
+                        if fut is not None:
+                            fut.set_result(seq)
+        except Exception as e:  # engine wedged: fail fast and visibly
+            log.error("engine loop died", error=repr(e))
+            self._failed = f"{type(e).__name__}: {e}"
+            self._fail_outstanding(RuntimeError(f"engine failed: {self._failed}"))
+
+    # -- request path -------------------------------------------------------
+    def submit(
+        self, prompt_tokens: list[int], sampling: Optional[SamplingParams] = None
+    ) -> Future:
+        """Enqueue a request; the Future resolves to the finished Sequence
+        (or raises: invalid request, engine failure, shutdown)."""
+        # Surface obviously-bad requests synchronously with the same checks
+        # add_request applies (the rest raise through the Future).
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        fut: Future = Future()
+        with self._work:
+            if self._failed is not None:
+                raise RuntimeError(f"engine failed: {self._failed}")
+            if not self._running:
+                raise RuntimeError("pod server not running")
+            self._staging.append((list(prompt_tokens), sampling, fut))
+            self._work.notify()
+        return fut
+
+    def generate(
+        self,
+        prompt_tokens: list[int],
+        sampling: Optional[SamplingParams] = None,
+        timeout: Optional[float] = None,
+    ) -> Sequence:
+        return self.submit(prompt_tokens, sampling).result(timeout=timeout)
+
+    # -- HTTP surface -------------------------------------------------------
+    def build_app(self):
+        from aiohttp import web
+
+        async def completions(request: web.Request) -> web.Response:
+            import asyncio
+
+            try:
+                body = await request.json()
+            except Exception:
+                return web.json_response({"error": "invalid JSON"}, status=400)
+
+            prompt = body.get("prompt")
+            token_ids = body.get("prompt_token_ids")
+            if token_ids is None:
+                if not isinstance(prompt, str) or not prompt:
+                    return web.json_response(
+                        {"error": "prompt or prompt_token_ids required"}, status=400
+                    )
+                if self._tokenizer is None:
+                    return web.json_response(
+                        {"error": "no tokenizer loaded; pass prompt_token_ids"},
+                        status=400,
+                    )
+                token_ids, _ = self._tokenizer.encode(prompt, self.config.model_name)
+
+            try:
+                sampling = SamplingParams(
+                    max_new_tokens=int(body.get("max_tokens", 64)),
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                )
+                token_ids = [int(t) for t in token_ids]
+            except (TypeError, ValueError) as e:
+                return web.json_response(
+                    {"error": f"invalid request field: {e}"}, status=400
+                )
+            try:
+                fut = self.submit(token_ids, sampling)
+                seq = await asyncio.get_event_loop().run_in_executor(
+                    None, fut.result
+                )
+            except ValueError as e:  # rejected by engine admission checks
+                return web.json_response({"error": str(e)}, status=400)
+            except RuntimeError as e:  # engine failure / shutdown
+                return web.json_response({"error": str(e)}, status=503)
+            if seq.error:
+                return web.json_response({"error": seq.error}, status=500)
+
+            # Preemption-stable outputs (output_tokens may have been folded
+            # into the prompt when a sequence was preempted and recomputed).
+            out_tokens = seq.generated_tokens
+            text = None
+            if self._tokenizer is not None:
+                text = self._tokenizer.decode(out_tokens, self.config.model_name)
+            stopped = bool(out_tokens) and out_tokens[-1] in sampling.stop_token_ids
+            return web.json_response(
+                {
+                    "id": seq.request_id,
+                    "object": "text_completion",
+                    "model": self.config.model_name,
+                    "choices": [
+                        {
+                            "index": 0,
+                            "text": text,
+                            "token_ids": out_tokens,
+                            "finish_reason": "stop" if stopped else "length",
+                        }
+                    ],
+                    "usage": {
+                        "prompt_tokens": seq.user_prompt_len,
+                        "completion_tokens": seq.num_generated,
+                        "cached_prompt_tokens": seq.num_cached_prompt,
+                    },
+                    "ttft_s": seq.ttft,
+                }
+            )
+
+        async def healthz(_request: web.Request) -> web.Response:
+            if self._failed is not None:
+                return web.json_response(
+                    {"status": "failed", "error": self._failed}, status=503
+                )
+            return web.json_response({"status": "ok"})
+
+        async def stats(_request: web.Request) -> web.Response:
+            bm = self.engine.block_manager
+            with self._mu:
+                staged = len(self._staging)
+            payload = {
+                "pod": self.config.pod_identifier,
+                "model": self.config.model_name,
+                "data_parallel_rank": self.config.data_parallel_rank,
+                "staged": staged,
+                "waiting": len(self.engine.scheduler.waiting),
+                "running": len(self.engine.scheduler.running),
+                "free_pages": bm.num_free,
+                "total_pages": bm.config.total_pages,
+            }
+            return web.json_response(payload)
+
+        app = web.Application()
+        app.router.add_post("/v1/completions", completions)
+        app.router.add_get("/healthz", healthz)
+        app.router.add_get("/stats", stats)
+        return app
+
+
+def _resolve_model(name: str) -> LlamaConfig:
+    from .. import models
+
+    presets = {
+        "tiny-llama": models.TINY_LLAMA,
+        "tiny-moe": models.TINY_MOE,
+        "meta-llama/Llama-3.1-8B-Instruct": models.LLAMA_3_8B,
+        "meta-llama/Meta-Llama-3-8B": models.LLAMA_3_8B,
+        "meta-llama/Llama-3.1-70B-Instruct": models.LLAMA_3_70B,
+        "Qwen/Qwen2.5-0.5B-Instruct": models.QWEN2_5_0_5B,
+        "Qwen/Qwen3-32B": models.QWEN3_32B,
+        "mistralai/Mixtral-8x7B-Instruct-v0.1": models.MIXTRAL_8X7B,
+    }
+    if name in presets:
+        return presets[name]
+    raise SystemExit(
+        f"unknown model {name!r}; known presets: {sorted(presets)} "
+        "(HF checkpoint loading: see models.hf_loader.load_hf_state_dict)"
+    )
+
+
+def main() -> None:
+    from aiohttp import web
+
+    config = PodServerConfig.from_env()
+    config.engine.model = _resolve_model(config.model_name)
+
+    tokenizer = None
+    if os.environ.get("LOAD_TOKENIZER", "0") not in ("0", "false"):
+        from ..tokenization.tokenizer import CachedHFTokenizer
+
+        tokenizer = CachedHFTokenizer()
+
+    server = PodServer(config, tokenizer=tokenizer)
+    server.start()
+    log.info(
+        "TPU pod server listening",
+        port=config.http_port,
+        pod=config.pod_identifier,
+        model=config.model_name,
+        zmq=config.zmq_endpoint,
+    )
+    try:
+        web.run_app(server.build_app(), port=config.http_port)
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,11 @@ class Tokenizer(ABC):
     def encode(self, prompt: str, model_name: str) -> tuple[list[int], list[Offset]]:
         """Return (token ids, byte offsets) for ``prompt``."""
 
+    def decode(self, token_ids: Sequence[int], model_name: str) -> Optional[str]:
+        """Detokenize, or None if this tokenizer cannot produce text (the
+        serving path then returns token ids only)."""
+        return None
+
 
 def char_offsets_to_byte_offsets(prompt: str, offsets: Sequence[Offset]) -> list[Offset]:
     """Convert character-based (lo, hi) offsets into UTF-8 byte offsets.
@@ -94,3 +99,8 @@ class CachedHFTokenizer(Tokenizer):
         tok = self._get_tokenizer(model_name)
         enc = tok.encode(prompt)
         return list(enc.ids), char_offsets_to_byte_offsets(prompt, enc.offsets)
+
+    def decode(self, token_ids: Sequence[int], model_name: str) -> str:
+        """Detokenize (the serving path's response text)."""
+        tok = self._get_tokenizer(model_name)
+        return tok.decode(list(token_ids), skip_special_tokens=True)

@@ -28,3 +28,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer  # noqa: E402
+
+
+class CharTokenizer(Tokenizer):
+    """Shared offline test tokenizer: token id = ord(char), byte offsets."""
+
+    def encode(self, prompt, model_name):
+        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]

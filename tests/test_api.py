@@ -15,6 +15,8 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry
 from llm_d_kv_cache_manager_tpu.server.api import ScoringService, ServiceConfig
 from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
 
+from conftest import CharTokenizer
+
 MODEL = "test-model"
 TEMPLATE = (
     "{% for message in messages %}"
@@ -22,11 +24,6 @@ TEMPLATE = (
     "{% endfor %}"
     "{% if add_generation_prompt %}<|assistant|>{% endif %}"
 )
-
-
-class CharTokenizer(Tokenizer):
-    def encode(self, prompt, model_name):
-        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
 
 
 def _free_port() -> int:

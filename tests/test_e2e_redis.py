@@ -12,7 +12,6 @@ import time
 import pytest
 
 from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
-from llm_d_kv_cache_manager_tpu.kvcache.indexer import KVCacheIndexerConfig
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
     DeviceTier,
     PodEntry,
@@ -30,18 +29,13 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
     KVEventsPoolConfig,
     Message,
 )
-from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
 from llm_d_kv_cache_manager_tpu.tokenization.pool import TokenizationPoolConfig
 
+from conftest import CharTokenizer
 from fake_redis import FakeRedis
 
 MODEL = "e2e-model"
 BLOCK = 4
-
-
-class CharTokenizer(Tokenizer):
-    def encode(self, prompt, model_name):
-        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
 
 
 @pytest.fixture
@@ -131,11 +125,7 @@ class TestRedisBackedWritePath:
                     seq=1,
                 )
             )
-            deadline = time.time() + 5
-            while time.time() < deadline:
-                if indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 4}:
-                    break
-                time.sleep(0.01)
+            assert pool.drain(timeout=10.0)
             assert indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 4}
 
             removal = EventBatch(
@@ -151,11 +141,7 @@ class TestRedisBackedWritePath:
                     seq=2,
                 )
             )
-            deadline = time.time() + 5
-            while time.time() < deadline:
-                if indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 2}:
-                    break
-                time.sleep(0.01)
+            assert pool.drain(timeout=10.0)
             assert indexer.get_pod_scores(prompt, MODEL) == {"tpu-pod-7": 2}
         finally:
             pool.shutdown()

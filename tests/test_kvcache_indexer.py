@@ -16,13 +16,10 @@ from llm_d_kv_cache_manager_tpu.tokenization.prefixstore import LRUTokenStore
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import KVCacheIndexerConfig
 from llm_d_kv_cache_manager_tpu.tokenization.pool import TokenizationPoolConfig
 
+from conftest import CharTokenizer
+
 MODEL = "test-model"
 BLOCK = 4  # small token block size, like the reference e2e (block size 4)
-
-
-class CharTokenizer(Tokenizer):
-    def encode(self, prompt, model_name):
-        return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
 
 
 @pytest.fixture

@@ -227,11 +227,7 @@ class TestZMQEndToEnd:
     def test_offline_demo_flow(self):
         from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock import TokenProcessorConfig
-        from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
-
-        class CharTok(Tokenizer):
-            def encode(self, p, m):
-                return [ord(c) for c in p], [(i, i + 1) for i in range(len(p))]
+        from conftest import CharTokenizer as CharTok
 
         port = 15571
         indexer = KVCacheIndexer(
