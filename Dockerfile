@@ -1,14 +1,19 @@
 # Image for both binaries: the scoring service (server.api) and the TPU pod
 # server (server.serve). Select via the container command.
+#
+# The scoring service runs on CPU nodes with the default build. TPU serving
+# pods (deploy/tpu-serving/) need the TPU jax wheel:
+#   docker build --build-arg JAX_SPEC='jax[tpu]' -t kv-cache-manager-tpu:tpu .
 FROM python:3.12-slim
 
 RUN apt-get update && apt-get install -y --no-install-recommends \
         g++ libzmq3-dev && \
     rm -rf /var/lib/apt/lists/*
 
+ARG JAX_SPEC=jax
 WORKDIR /app
 COPY requirements.txt .
-RUN pip install --no-cache-dir -r requirements.txt
+RUN pip install --no-cache-dir "${JAX_SPEC}" -r requirements.txt
 
 COPY llm_d_kv_cache_manager_tpu/ llm_d_kv_cache_manager_tpu/
 # Build the C++ chained-hash kernel (pure-Python fallback exists, but the

@@ -52,15 +52,15 @@ def _paged_attention_tp(q, kp, vp, block_tables, seq_lens, *, interpret, mesh):
     """
     if mesh is None:
         return paged_attention(q, kp, vp, block_tables, seq_lens, interpret=interpret)
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    fn = shard_map(
+    from ..parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
         functools.partial(paged_attention, interpret=interpret),
         mesh=mesh,
         in_specs=(P(None, "tp"), P("tp"), P("tp"), P(), P()),
         out_specs=P(None, "tp"),
-        check_rep=False,
     )
     return fn(q, kp, vp, block_tables, seq_lens)
 

@@ -1,10 +1,14 @@
-from .mesh import make_mesh, MeshConfig
+from .mesh import make_mesh, MeshConfig, shard_map_compat
+from .ring_attention import ring_attention, ring_attention_shard
 from .sharding import param_shardings, batch_sharding, shard_params
 from .train import train_step, make_train_state, loss_fn
 
 __all__ = [
     "make_mesh",
     "MeshConfig",
+    "shard_map_compat",
+    "ring_attention",
+    "ring_attention_shard",
     "param_shardings",
     "batch_sharding",
     "shard_params",

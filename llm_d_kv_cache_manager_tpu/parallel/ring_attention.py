@@ -1,0 +1,142 @@
+"""Ring attention: sequence-parallel causal attention for long-context prefill.
+
+The reference never runs a model, so sequence scaling has no analogue there
+(SURVEY §5); in this framework long context is first-class and the engine's
+single-chip ceiling is ``max_model_len``. Ring attention removes it: the
+sequence is sharded over a mesh axis (``sp``), every device computes flash
+attention for its query shard while K/V shards rotate around the ring via
+``jax.lax.ppermute`` — ICI-neighbor traffic only, no all-gather, and peak
+memory O(seq/n · block) per chip.
+
+The math is the blockwise online-softmax merge (same accumulator discipline
+as ``ops.attention._flash_over_keys``): each ring step contributes a partial
+(max, sum, acc) that is merged exactly, so the result is bit-consistent with
+single-device flash attention up to float-associativity.
+
+Layout notes (TPU-first):
+- Q/K/V stay ``[b, s/n, heads, d]`` per shard; einsums keep the contraction
+  shapes MXU-friendly ([s/n, s/n] score tiles per step).
+- The rotation count is static (mesh size), so the whole ring unrolls inside
+  one jit: XLA overlaps each step's ppermute with the previous step's
+  compute (double-buffered collective-permute).
+- Causality is enforced with absolute positions: shard *i* holds positions
+  ``i·s/n … (i+1)·s/n − 1``; a whole ring step whose K shard lies entirely
+  in the query shard's future contributes nothing and its FLOPs are skipped
+  by masking (the lax.scan stays shape-static as XLA requires).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ring_body(carry, _, *, axis_name, qf, q_pos, scale, n_shards):
+    """One ring step: attend my query shard to the K/V shard currently held,
+    then pass that shard to the next device on the ring."""
+    k_cur, v_cur, kpos_cur, m, l, acc = carry
+
+    # [b, n_kv, g, s_q, s_k] score tile for this step.
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32)) * scale
+    mask = kpos_cur[:, None, None, None, :] <= q_pos[:, None, None, :, None]
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    p = jnp.exp(scores - m_new[..., None]) * mask
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhgqk,bkhd->bhgqd", p, v_cur.astype(jnp.float32)
+    )
+
+    # Rotate K/V/pos to the next device; neighbor-only ICI traffic.
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+    v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+    kpos_nxt = jax.lax.ppermute(kpos_cur, axis_name, perm)
+    return (k_nxt, v_nxt, kpos_nxt, m_new, l_new, acc_new), None
+
+
+def ring_attention_shard(
+    q: jnp.ndarray,  # [b, s_shard, n_heads, d]
+    k: jnp.ndarray,  # [b, s_shard, n_kv_heads, d]
+    v: jnp.ndarray,  # [b, s_shard, n_kv_heads, d]
+    *,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Per-shard ring attention body. Must run inside ``shard_map`` (or pmap)
+    over ``axis_name``; q/k/v are this device's sequence shard."""
+    b, s, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    if scale is None:
+        scale = d**-0.5
+    n_shards = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    q_pos = (my * s + jnp.arange(s))[None, :].astype(jnp.int32)
+    q_pos = jnp.broadcast_to(q_pos, (b, s))
+    k_pos = q_pos  # at step 0 each device holds its own K shard
+
+    qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
+    m0 = jnp.full((b, n_kv, group, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n_kv, group, s), jnp.float32)
+    acc0 = jnp.zeros((b, n_kv, group, s, d), jnp.float32)
+
+    body = partial(
+        _ring_body,
+        axis_name=axis_name,
+        qf=qf,
+        q_pos=q_pos,
+        scale=scale,
+        n_shards=n_shards,
+    )
+    (_, _, _, m, l, acc), _ = jax.lax.scan(
+        body, (k, v, k_pos, m0, l0, acc0), None, length=n_shards
+    )
+
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+    # A query with no visible keys cannot happen here (it always sees
+    # itself), so no NaN guard is needed beyond the l>0 clamp.
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, n_q, d).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [b, seq, n_heads, d] — seq divisible by mesh axis size
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel causal attention over ``mesh[axis_name]``.
+
+    Shards the sequence dimension, runs the ring under ``shard_map``, and
+    returns the output with the same (sequence-sharded) layout. Jit-able and
+    composable with tp sharding on the head dimension of the surrounding
+    projections.
+    """
+    from .mesh import shard_map_compat
+
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name!r} of size {n}"
+        )
+    spec = P(None, axis_name, None, None)
+    fn = shard_map_compat(
+        partial(ring_attention_shard, axis_name=axis_name, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

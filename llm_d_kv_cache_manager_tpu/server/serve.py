@@ -44,6 +44,16 @@ from .sequence import SamplingParams, Sequence
 log = get_logger("server.serve")
 
 
+def _env_bool(name: str, default: str) -> bool:
+    return os.environ.get(name, default).strip().lower() not in (
+        "0",
+        "false",
+        "no",
+        "off",
+        "",
+    )
+
+
 @dataclass
 class PodServerConfig:
     model_name: str = "tiny-llama"
@@ -62,7 +72,7 @@ class PodServerConfig:
         cfg.model_name = os.environ.get("MODEL_NAME", cfg.model_name)
         cfg.pod_identifier = os.environ.get("POD_IDENTIFIER", cfg.pod_identifier)
         cfg.zmq_endpoint = os.environ.get("ZMQ_ENDPOINT", cfg.zmq_endpoint)
-        cfg.publish_events = os.environ.get("PUBLISH_EVENTS", "1") not in ("0", "false")
+        cfg.publish_events = _env_bool("PUBLISH_EVENTS", "1")
         if "DP_RANK" in os.environ:
             cfg.data_parallel_rank = int(os.environ["DP_RANK"])
         cfg.http_port = int(os.environ.get("HTTP_PORT", cfg.http_port))
@@ -85,7 +95,7 @@ class PodServerConfig:
             os.environ.get("DECODE_STEPS_PER_ITER", eng.decode_steps_per_iter)
         )
         # CPU smoke runs (Pallas interpreter mode); never set on real TPU.
-        eng.interpret = os.environ.get("INTERPRET", "0") not in ("0", "false")
+        eng.interpret = _env_bool("INTERPRET", "0")
         return cfg
 
 
@@ -254,11 +264,13 @@ class PodServer:
                 token_ids, _ = self._tokenizer.encode(prompt, self.config.model_name)
 
             try:
+                stop_ids = [int(t) for t in body.get("stop_token_ids", [])]
                 sampling = SamplingParams(
                     max_new_tokens=int(body.get("max_tokens", 64)),
                     temperature=float(body.get("temperature", 0.0)),
                     top_k=int(body.get("top_k", 0)),
                     top_p=float(body.get("top_p", 1.0)),
+                    stop_token_ids=tuple(stop_ids),
                 )
                 token_ids = [int(t) for t in token_ids]
             except (TypeError, ValueError) as e:
@@ -267,9 +279,7 @@ class PodServer:
                 )
             try:
                 fut = self.submit(token_ids, sampling)
-                seq = await asyncio.get_event_loop().run_in_executor(
-                    None, fut.result
-                )
+                seq = await asyncio.wrap_future(fut)
             except ValueError as e:  # rejected by engine admission checks
                 return web.json_response({"error": str(e)}, status=400)
             except RuntimeError as e:  # engine failure / shutdown
@@ -282,7 +292,12 @@ class PodServer:
             out_tokens = seq.generated_tokens
             text = None
             if self._tokenizer is not None:
-                text = self._tokenizer.decode(out_tokens, self.config.model_name)
+                try:
+                    text = self._tokenizer.decode(out_tokens, self.config.model_name)
+                except Exception as e:
+                    # Generation succeeded; a broken/unloadable tokenizer must
+                    # not turn the response into a 500 — token ids suffice.
+                    log.warning("decode failed", error=repr(e))
             stopped = bool(out_tokens) and out_tokens[-1] in sampling.stop_token_ids
             return web.json_response(
                 {
@@ -364,10 +379,12 @@ def main() -> None:
     config.engine.model = _resolve_model(config.model_name)
 
     tokenizer = None
-    if os.environ.get("LOAD_TOKENIZER", "0") not in ("0", "false"):
-        from ..tokenization.tokenizer import CachedHFTokenizer
+    if _env_bool("LOAD_TOKENIZER", "0"):
+        from ..tokenization.tokenizer import CachedHFTokenizer, HFTokenizerConfig
 
-        tokenizer = CachedHFTokenizer()
+        tokenizer = CachedHFTokenizer(
+            HFTokenizerConfig(huggingface_token=os.environ.get("HF_TOKEN") or None)
+        )
 
     server = PodServer(config, tokenizer=tokenizer)
     server.start()

@@ -13,7 +13,6 @@ from aiohttp.test_utils import TestClient, TestServer
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry
 from llm_d_kv_cache_manager_tpu.server.api import ScoringService, ServiceConfig
-from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
 
 from conftest import CharTokenizer
 

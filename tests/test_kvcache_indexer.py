@@ -10,7 +10,6 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
     PodEntry,
     TokenProcessorConfig,
 )
-from llm_d_kv_cache_manager_tpu.tokenization import Tokenizer
 from llm_d_kv_cache_manager_tpu.tokenization.prefixstore import Config as PSConfig
 from llm_d_kv_cache_manager_tpu.tokenization.prefixstore import LRUTokenStore
 from llm_d_kv_cache_manager_tpu.kvcache.indexer import KVCacheIndexerConfig

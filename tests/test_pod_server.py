@@ -183,6 +183,32 @@ class TestHTTP:
 
         self._run(scenario)
 
+    def test_stop_token_ids_honored(self):
+        async def scenario(c, server, pub):
+            prompt = _prompt(7, 10)
+            # Discover the greedy continuation, then stop on its 2nd token.
+            resp = await c.post(
+                "/v1/completions",
+                json={"prompt_token_ids": prompt, "max_tokens": 6},
+            )
+            full = (await resp.json())["choices"][0]["token_ids"]
+            stop = full[1]
+            resp = await c.post(
+                "/v1/completions",
+                json={
+                    "prompt_token_ids": prompt,
+                    "max_tokens": 6,
+                    "stop_token_ids": [stop],
+                },
+            )
+            data = await resp.json()
+            # Generation halts at the first occurrence of the stop token.
+            expected = full[: full.index(stop) + 1]
+            assert data["choices"][0]["token_ids"] == expected
+            assert data["choices"][0]["finish_reason"] == "stop"
+
+        self._run(scenario)
+
     def test_completions_validation(self):
         async def scenario(c, server, pub):
             resp = await c.post("/v1/completions", json={})
