@@ -1,0 +1,174 @@
+"""Read/write-path micro-benchmarks for the routing stack (no TPU needed).
+
+The analogue of the reference's Go benchmarks (tokenization pool throughput,
+``pool_test.go:199-269``) plus the hot-RPC latency the TTFT wins depend on:
+``score_tokens`` = chunked sha256-CBOR hashing → index lookup → longest-
+prefix scoring. Compares the pure-Python and C++ (hashcore / lruindex)
+paths.
+
+Run: ``python benchmarking/bench_routing.py``; prints one JSON line per
+measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    IndexConfig,
+    InMemoryIndexConfig,
+    NativeMemoryIndexConfig,
+    PodEntry,
+    TokenProcessorConfig,
+    native_available,
+)
+
+MODEL = "bench/model"
+N_PODS = 8
+REPS = 200
+
+
+def bench_score_tokens(n_tokens: int, use_native_hash: bool, use_native_index: bool):
+    cfg = KVCacheIndexerConfig(
+        token_processor=TokenProcessorConfig(block_size=16, use_native=use_native_hash),
+        index=IndexConfig(
+            native_memory=NativeMemoryIndexConfig(size=1_000_000)
+            if use_native_index
+            else None,
+            in_memory=None if use_native_index else InMemoryIndexConfig(size=1_000_000),
+        ),
+    )
+    ix = KVCacheIndexer(cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 128_000, n_tokens).tolist()
+    keys = ix.token_processor.tokens_to_kv_block_keys(tokens, MODEL)
+    # Warm the index: every pod holds a staggered prefix depth.
+    for p in range(N_PODS):
+        depth = len(keys) * (p + 1) // N_PODS
+        ix.kv_block_index.add(keys[:depth], [PodEntry(f"pod-{p}")])
+
+    lat = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        scores = ix.score_tokens(tokens, MODEL)
+        lat.append(time.perf_counter() - t0)
+    assert scores[f"pod-{N_PODS-1}"] == len(keys)
+    return {
+        "metric": "score_tokens_latency",
+        "n_tokens": n_tokens,
+        "native_hash": use_native_hash,
+        "native_index": use_native_index,
+        "p50_us": round(1e6 * statistics.median(lat), 1),
+        "p99_us": round(1e6 * sorted(lat)[int(0.99 * len(lat))], 1),
+    }
+
+
+def bench_pool_throughput(sync: bool):
+    from llm_d_kv_cache_manager_tpu.tokenization import (
+        TokenizationPool,
+        TokenizationPoolConfig,
+        Tokenizer,
+    )
+
+    class CharTokenizer(Tokenizer):
+        def encode(self, prompt, model_name):
+            return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
+
+    pool = TokenizationPool(
+        TokenizationPoolConfig(workers_count=5), tokenizer=CharTokenizer()
+    )
+    pool.run()
+    n_tasks = 2000
+    prompts = [f"prompt {i} " + "x" * 200 for i in range(n_tasks)]
+    t0 = time.perf_counter()
+    if sync:
+        for p in prompts:
+            pool.tokenize(p, MODEL)
+    else:
+        for p in prompts:
+            pool.enqueue_tokenization(p, MODEL)
+        pool.drain(timeout=60)
+    dt = time.perf_counter() - t0
+    pool.shutdown()
+    return {
+        "metric": "tokenization_pool_throughput",
+        "mode": "sync" if sync else "async",
+        "tasks_per_s": round(n_tasks / dt, 1),
+    }
+
+
+def bench_event_ingest():
+    from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+        BlockStored,
+        EventBatch,
+        KVEventsPool,
+        KVEventsPoolConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvevents.pool import Message
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import create_index
+
+    index = create_index(IndexConfig(in_memory=InMemoryIndexConfig(size=1_000_000)))
+    pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=4))
+    pool.start()
+    rng = np.random.default_rng(1)
+    n_batches, blocks_per_batch = 2000, 16
+    payloads = []
+    for b in range(n_batches):
+        hashes = rng.integers(0, 2**63, blocks_per_batch).tolist()
+        batch = EventBatch(
+            ts=0.0,
+            events=[
+                BlockStored(
+                    block_hashes=hashes,
+                    parent_block_hash=None,
+                    token_ids=list(range(blocks_per_batch * 16)),
+                    block_size=16,
+                )
+            ],
+        )
+        payloads.append((f"pod-{b % N_PODS}", batch.to_payload()))
+    t0 = time.perf_counter()
+    for pod, payload in payloads:
+        pool.add_task(
+            Message(
+                topic=f"kv@{pod}@{MODEL}",
+                pod_identifier=pod,
+                model_name=MODEL,
+                payload=payload,
+            )
+        )
+    assert pool.drain(timeout=120)
+    dt = time.perf_counter() - t0
+    pool.shutdown()
+    return {
+        "metric": "event_ingest_throughput",
+        "batches_per_s": round(n_batches / dt, 1),
+        "blocks_per_s": round(n_batches * blocks_per_batch / dt, 1),
+    }
+
+
+def main():
+    results = []
+    for n_tokens in (1024, 4096, 16384):
+        for nh, ni in ((False, False), (True, False), (True, True)):
+            if ni and not native_available():
+                continue
+            results.append(bench_score_tokens(n_tokens, nh, ni))
+    results.append(bench_pool_throughput(sync=True))
+    results.append(bench_pool_throughput(sync=False))
+    results.append(bench_event_ingest())
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,8 @@ from .kvblock import (
     TokenProcessorConfig,
     create_index,
 )
-from .scorer import KVBlockScorer, KVBlockScorerConfig, new_scorer
+from .kvblock.keys import Key
+from .scorer import KVBlockScorer, KVBlockScorerConfig, ScoringStrategy, new_scorer
 
 log = get_logger("kvcache.indexer")
 
@@ -61,6 +62,17 @@ class KVCacheIndexer:
         self.tokenization_pool = TokenizationPool(
             self.config.tokenization_pool, store=prefix_store, tokenizer=tokenizer
         )
+        # Fused native paths: lookup+score in one C++ call when the backend
+        # offers it and the strategy matches (NativeMemoryIndex).
+        fused_ok = self.scorer.strategy == ScoringStrategy.LONGEST_PREFIX
+        self._fused_score = (
+            getattr(self.kv_block_index, "score_longest_prefix", None)
+            if fused_ok
+            else None
+        )
+        self._fused_hash_score = (
+            getattr(self.kv_block_index, "score_hashes", None) if fused_ok else None
+        )
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -89,10 +101,7 @@ class KVCacheIndexer:
             return {}
 
         pod_filter = set(pod_identifiers) if pod_identifiers else set()
-        key_to_pods = self.kv_block_index.lookup(block_keys, pod_filter)
-        log.debug("index lookup", n_hits=len(key_to_pods))
-
-        scores = self.scorer.score(block_keys, key_to_pods)
+        scores = self._lookup_and_score(block_keys, pod_filter)
         log.debug("scored pods", scores=scores)
         return scores
 
@@ -104,9 +113,25 @@ class KVCacheIndexer:
     ) -> dict[str, int]:
         """Scoring entry for callers that already hold token ids (the in-tree
         JAX server's router path — skips the tokenizer pool hop)."""
+        pod_filter = set(pod_identifiers) if pod_identifiers else set()
+        if self._fused_hash_score is not None:
+            # Zero-object hot path: C++ hash chain → C++ fused lookup+score;
+            # no Key instances are built at all.
+            hashes = self.token_processor.prefix_hashes(tokens)
+            if not hashes:
+                return {}
+            return self._fused_hash_score(model_name, hashes, pod_filter)
         block_keys = self.token_processor.tokens_to_kv_block_keys(tokens, model_name)
         if not block_keys:
             return {}
-        pod_filter = set(pod_identifiers) if pod_identifiers else set()
+        return self._lookup_and_score(block_keys, pod_filter)
+
+    def _lookup_and_score(
+        self, block_keys: list[Key], pod_filter: set[str]
+    ) -> dict[str, int]:
+        if self._fused_score is not None:
+            scores = self._fused_score(block_keys, pod_filter)
+            if scores is not None:
+                return scores
         key_to_pods = self.kv_block_index.lookup(block_keys, pod_filter)
         return self.scorer.score(block_keys, key_to_pods)

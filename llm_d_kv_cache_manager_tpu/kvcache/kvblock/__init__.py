@@ -4,12 +4,14 @@ from .index import (
     IndexConfig,
     InMemoryIndexConfig,
     CostAwareMemoryIndexConfig,
+    NativeMemoryIndexConfig,
     RedisIndexConfig,
     create_index,
 )
 from .in_memory import InMemoryIndex
 from .cost_aware import CostAwareMemoryIndex
 from .instrumented import InstrumentedIndex
+from .native_memory import NativeMemoryIndex, native_available
 from .token_processor import (
     ChunkedTokenDatabase,
     TokenProcessorConfig,
@@ -28,6 +30,9 @@ __all__ = [
     "InMemoryIndex",
     "CostAwareMemoryIndex",
     "InstrumentedIndex",
+    "NativeMemoryIndexConfig",
+    "NativeMemoryIndex",
+    "native_available",
     "Key",
     "PodEntry",
     "DeviceTier",

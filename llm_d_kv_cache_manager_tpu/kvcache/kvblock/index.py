@@ -59,6 +59,15 @@ class CostAwareMemoryIndexConfig:
 
 
 @dataclass
+class NativeMemoryIndexConfig:
+    """C++ two-level LRU (same semantics as InMemoryIndexConfig); requires
+    the native library (``python -m llm_d_kv_cache_manager_tpu.native.build``)."""
+
+    size: int = 100_000_000
+    pod_cache_size: int = 10
+
+
+@dataclass
 class RedisIndexConfig:
     # URL form: redis://[user:pass@]host:port/db
     address: str = "redis://localhost:6379"
@@ -69,9 +78,10 @@ class RedisIndexConfig:
 
 @dataclass
 class IndexConfig:
-    """Picks the first configured backend: in-memory > cost-aware > redis
-    (reference ``index.go:57-97``)."""
+    """Picks the first configured backend: native > in-memory > cost-aware >
+    redis (extending reference ``index.go:57-97`` with the C++ backend)."""
 
+    native_memory: Optional[NativeMemoryIndexConfig] = None
     in_memory: Optional[InMemoryIndexConfig] = field(default_factory=InMemoryIndexConfig)
     cost_aware: Optional[CostAwareMemoryIndexConfig] = None
     redis: Optional[RedisIndexConfig] = None
@@ -85,7 +95,11 @@ def create_index(config: Optional[IndexConfig] = None) -> Index:
     cfg = config or IndexConfig()
 
     idx: Index
-    if cfg.in_memory is not None:
+    if cfg.native_memory is not None:
+        from .native_memory import NativeMemoryIndex
+
+        idx = NativeMemoryIndex(cfg.native_memory)
+    elif cfg.in_memory is not None:
         from .in_memory import InMemoryIndex
 
         idx = InMemoryIndex(cfg.in_memory)

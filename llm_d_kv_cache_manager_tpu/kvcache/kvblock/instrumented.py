@@ -50,3 +50,31 @@ class InstrumentedIndex(Index):
         self._inner.evict(key, entries)
         collector.evictions.inc(len(entries))
         collector.bump("evictions", len(entries))
+
+    def __getattr__(self, name: str):
+        # Fused scoring entry points (NativeMemoryIndex) pass through the
+        # decorator with the same lookup metrics; __getattr__ only fires
+        # when the attribute is absent here, so plain backends stay plain
+        # and the indexer's getattr discovery keeps working. The *_with_hits
+        # variants report the same keys-with-surviving-pods hit count the
+        # two-step path records, so NATIVE_INDEX does not shift dashboards.
+        if name in ("score_longest_prefix", "score_hashes"):
+            inner_fn = getattr(self._inner, name + "_with_hits")
+
+            def wrapped(*args, **kwargs):
+                start = time.perf_counter()
+                out = inner_fn(*args, **kwargs)
+                elapsed = time.perf_counter() - start
+                if out is None:  # mixed-model fallback: two-step path counts
+                    return None
+                collector.lookup_requests.inc()
+                collector.bump("lookup_requests")
+                collector.lookup_latency.observe(elapsed)
+                scores, hits = out
+                if hits:
+                    collector.lookup_hits.inc(hits)
+                    collector.bump("lookup_hits", hits)
+                return scores
+
+            return wrapped
+        raise AttributeError(name)

@@ -1,0 +1,204 @@
+"""Native (C++) in-memory index backend.
+
+Same contract and two-level-LRU semantics as ``InMemoryIndex`` (the parity
+port of the reference's ``in_memory.go``), with the hot structure in C++
+behind a ctypes boundary: integer-only calls on the lookup path (model and
+pod names are interned to u32 ids here, tiers to u8), one native call per
+``lookup``/``add`` batch instead of per-key Python dict/lock traffic.
+
+Passes the same backend conformance suite as every other Index
+(tests/test_index_backends.py), and is selected via
+``IndexConfig.native_memory`` when the shared library is built.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from ...native import lruindex as _native
+from ...utils import get_logger
+from .index import Index, NativeMemoryIndexConfig
+from .keys import DeviceTier, Key, PodEntry
+
+log = get_logger("kvcache.kvblock.native_memory")
+
+_TIERS = list(DeviceTier)
+_TIER_TO_ID = {t: i for i, t in enumerate(_TIERS)}
+
+
+def native_available() -> bool:
+    return _native.available()
+
+
+class NativeMemoryIndex(Index):
+    #: filter id that matches no interned pod: filters everything out while
+    #: still walking (and LRU-promoting) the chain like the Python backend.
+    _NO_MATCH_FILTER = 0xFFFFFFFF
+
+    def __init__(self, config: Optional[NativeMemoryIndexConfig] = None):
+        self.config = config or NativeMemoryIndexConfig()
+        self._idx = _native.NativeLru(self.config.size, self.config.pod_cache_size)
+        # Intern tables. Pods and models are few (fleet-sized); u32 is ample.
+        self._mu = threading.Lock()
+        self._model_ids: dict[str, int] = {}
+        self._pod_ids: dict[str, int] = {}
+        self._pod_names: list[str] = []
+
+    # -- interning ----------------------------------------------------------
+    def _model_id(self, name: str, *, create: bool) -> Optional[int]:
+        with self._mu:
+            mid = self._model_ids.get(name)
+            if mid is None and create:
+                mid = len(self._model_ids)
+                self._model_ids[name] = mid
+            return mid
+
+    def _pod_id(self, name: str, *, create: bool) -> Optional[int]:
+        with self._mu:
+            pid = self._pod_ids.get(name)
+            if pid is None and create:
+                pid = len(self._pod_names)
+                self._pod_ids[name] = pid
+                self._pod_names.append(name)
+            return pid
+
+    def _filter_ids(self, pod_filter: Optional[set[str]]) -> list[int]:
+        if not pod_filter:
+            return []
+        ids = []
+        for name in pod_filter:
+            pid = self._pod_id(name, create=False)
+            if pid is not None:
+                ids.append(pid)
+        # Every filter pod unknown: nothing can match, but the chain must
+        # still be walked (and keys promoted) exactly as the Python backend
+        # does — a no-match sentinel keeps filtering active.
+        return ids or [self._NO_MATCH_FILTER]
+
+    def _entry_ids(self, entries: Sequence[PodEntry], *, create: bool):
+        pods, tiers = [], []
+        for e in entries:
+            pid = self._pod_id(e.pod_identifier, create=create)
+            if pid is None:
+                continue
+            pods.append(pid)
+            tiers.append(_TIER_TO_ID[e.device_tier])
+        return pods, tiers
+
+    # -- Index contract -----------------------------------------------------
+    def lookup(
+        self, keys: Sequence[Key], pod_filter: Optional[set[str]] = None
+    ) -> dict[Key, list[str]]:
+        if not keys:
+            raise ValueError("no keys provided for lookup")
+        filter_ids = self._filter_ids(pod_filter)
+        out: dict[Key, list[str]] = {}
+        # One native call per consecutive same-model run (the hot path is
+        # always single-model; this keeps mixed-model batches correct).
+        i, n = 0, len(keys)
+        while i < n:
+            j = i
+            model = keys[i].model_name
+            while j < n and keys[j].model_name == model:
+                j += 1
+            mid = self._model_id(model, create=False)
+            if mid is None:
+                i = j  # unknown model: every key missing — chain continues
+                continue
+            processed, per_key = self._idx.lookup(
+                mid, [k.chunk_hash for k in keys[i:j]], filter_ids
+            )
+            with self._mu:
+                names = self._pod_names
+                for key, pods in zip(keys[i:j], per_key):
+                    if pods:
+                        out[key] = [names[pid] for pid, _tier in pods]
+            if processed < j - i:  # present-but-empty key: stop the scan
+                return out
+            i = j
+        return out
+
+    def add(self, keys: Sequence[Key], entries: Sequence[PodEntry]) -> None:
+        if not keys or not entries:
+            raise ValueError("no keys or entries provided for adding to index")
+        pods, tiers = self._entry_ids(entries, create=True)
+        i, n = 0, len(keys)
+        while i < n:  # one native call per consecutive same-model run
+            j = i
+            model = keys[i].model_name
+            while j < n and keys[j].model_name == model:
+                j += 1
+            mid = self._model_id(model, create=True)
+            self._idx.add(mid, [k.chunk_hash for k in keys[i:j]], pods, tiers)
+            i = j
+
+    def evict(self, key: Key, entries: Sequence[PodEntry]) -> None:
+        if not entries:
+            raise ValueError("no entries provided for eviction from index")
+        mid = self._model_id(key.model_name, create=False)
+        if mid is None:
+            return
+        pods, tiers = self._entry_ids(entries, create=False)
+        if pods:
+            self._idx.evict(mid, key.chunk_hash, pods, tiers)
+
+    def score_longest_prefix(
+        self,
+        keys: Sequence[Key],
+        pod_filter: Optional[set[str]] = None,
+    ) -> Optional[dict[str, int]]:
+        """Fused lookup+score in one native call (LongestPrefixScorer
+        semantics). Returns None when keys span models — the caller then
+        falls back to the two-step path."""
+        out = self.score_longest_prefix_with_hits(keys, pod_filter)
+        return None if out is None else out[0]
+
+    def score_longest_prefix_with_hits(
+        self,
+        keys: Sequence[Key],
+        pod_filter: Optional[set[str]] = None,
+    ) -> Optional[tuple[dict[str, int], int]]:
+        if not keys:
+            return {}, 0
+        model = keys[0].model_name
+        if any(k.model_name != model for k in keys[1:]):
+            return None
+        return self.score_hashes_with_hits(
+            model, [k.chunk_hash for k in keys], pod_filter
+        )
+
+    def score_hashes(
+        self,
+        model_name: str,
+        hashes: Sequence[int],
+        pod_filter: Optional[set[str]] = None,
+    ) -> dict[str, int]:
+        """Fused scoring from raw chain hashes — the zero-object hot path
+        (no Key allocation between the hash kernel and the index)."""
+        scores, _hits = self.score_hashes_with_hits(model_name, hashes, pod_filter)
+        return scores
+
+    def score_hashes_with_hits(
+        self,
+        model_name: str,
+        hashes: Sequence[int],
+        pod_filter: Optional[set[str]] = None,
+    ) -> tuple[dict[str, int], int]:
+        """Like ``score_hashes`` but also returns the lookup-hit count (keys
+        with a filter-surviving pod) so the instrumented decorator can report
+        metrics identical to the two-step path."""
+        if not hashes:
+            return {}, 0
+        mid = self._model_id(model_name, create=False)
+        if mid is None:
+            return {}, 0
+        scored, hits = self._idx.score(
+            mid, hashes, self._filter_ids(pod_filter)
+        )
+        with self._mu:
+            names = self._pod_names
+            return {names[pid]: int(s) for pid, s in scored}, hits
+
+    def __len__(self) -> int:
+        return self._idx.size()

@@ -1,4 +1,7 @@
-"""Build the native hash kernel: ``python -m llm_d_kv_cache_manager_tpu.native.build``."""
+"""Build the native kernels: ``python -m llm_d_kv_cache_manager_tpu.native.build``.
+
+Produces ``libhashcore.so`` (chained sha256-CBOR block hashing) and
+``liblruindex.so`` (two-level LRU block index)."""
 
 from __future__ import annotations
 
@@ -8,26 +11,25 @@ import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
+LIBS = {
+    "hashcore.cpp": "libhashcore.so",
+    "lruindex.cpp": "liblruindex.so",
+}
 
-def build(verbose: bool = True) -> str:
-    src = os.path.join(HERE, "hashcore.cpp")
-    out = os.path.join(HERE, "libhashcore.so")
-    cmd = [
-        "g++",
-        "-O3",
-        "-std=c++17",
-        "-shared",
-        "-fPIC",
-        src,
-        "-o",
-        out,
-    ]
-    if verbose:
-        print("+", " ".join(cmd), file=sys.stderr)
-    subprocess.run(cmd, check=True)
-    return out
+
+def build(verbose: bool = True) -> list[str]:
+    outs = []
+    for src_name, lib_name in LIBS.items():
+        src = os.path.join(HERE, src_name)
+        out = os.path.join(HERE, lib_name)
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o", out]
+        if verbose:
+            print("+", " ".join(cmd), file=sys.stderr)
+        subprocess.run(cmd, check=True)
+        outs.append(out)
+    return outs
 
 
 if __name__ == "__main__":
-    path = build()
-    print(path)
+    for path in build():
+        print(path)

@@ -54,6 +54,9 @@ class ServiceConfig:
     hf_token: Optional[str] = None
     enable_metrics: bool = True
     metrics_logging_interval: float = 0.0
+    # Use the C++ index backend when its library is built (strictly faster,
+    # same conformance-tested semantics); NATIVE_INDEX=0 forces pure Python.
+    native_index: bool = True
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -68,11 +71,28 @@ class ServiceConfig:
             hf_token=env.get("HF_TOKEN") or None,
             enable_metrics=env.get("ENABLE_METRICS", "true").lower() != "false",
             metrics_logging_interval=float(env.get("METRICS_LOGGING_INTERVAL", "0")),
+            native_index=env.get("NATIVE_INDEX", "1").lower() not in ("0", "false"),
         )
 
 
 class ScoringService:
     """Owns the indexer + event plane and exposes the HTTP handlers."""
+
+    @staticmethod
+    def _index_config(cfg: "ServiceConfig"):
+        from ..kvcache.kvblock import (
+            IndexConfig,
+            NativeMemoryIndexConfig,
+            native_available,
+        )
+
+        use_native = cfg.native_index and native_available()
+        return IndexConfig(
+            native_memory=NativeMemoryIndexConfig() if use_native else None,
+            in_memory=None if use_native else IndexConfig().in_memory,
+            enable_metrics=cfg.enable_metrics,
+            metrics_logging_interval=cfg.metrics_logging_interval,
+        )
 
     def __init__(self, config: Optional[ServiceConfig] = None, *, tokenizer=None):
         self.config = config or ServiceConfig()
@@ -85,10 +105,7 @@ class ScoringService:
                 token_processor=TokenProcessorConfig(
                     block_size=cfg.block_size, hash_seed=cfg.hash_seed
                 ),
-                index=IndexConfig(
-                    enable_metrics=cfg.enable_metrics,
-                    metrics_logging_interval=cfg.metrics_logging_interval,
-                ),
+                index=self._index_config(cfg),
                 tokenization_pool=TokenizationPoolConfig(
                     hf_tokenizer=HFTokenizerConfig(huggingface_token=cfg.hf_token)
                 ),

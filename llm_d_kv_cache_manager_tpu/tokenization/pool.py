@@ -128,13 +128,24 @@ class TokenizationPool:
         self._queue.put(_Task(prompt, model_name, result=fut))
         return fut.get(timeout)
 
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every queued task has been processed (for tests and
+        the async-throughput benchmark). A task awaiting its retry backoff
+        counts as done for this check. Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._queue.unfinished_tasks == 0:
+                return True
+            time.sleep(0.002)
+        return False
+
     # -- workers ------------------------------------------------------------
     def _worker_loop(self) -> None:
         while True:
             task = self._queue.get()
-            if task is None:
-                return
             try:
+                if task is None:
+                    return
                 self._process_task(task)
             except Exception as exc:
                 task.attempts += 1
@@ -154,6 +165,8 @@ class TokenizationPool:
                 else:
                     delay = _BASE_RETRY_DELAY * (2 ** (task.attempts - 1))
                     threading.Timer(delay, self._requeue, args=(task,)).start()
+            finally:
+                self._queue.task_done()
 
     def _requeue(self, task: _Task) -> None:
         """Retry hop; fails the task fast if the pool shut down meanwhile so

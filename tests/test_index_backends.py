@@ -45,6 +45,17 @@ BACKENDS = {
     ),
 }
 
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (  # noqa: E402
+    NativeMemoryIndex,
+    NativeMemoryIndexConfig,
+    native_available,
+)
+
+if native_available():
+    BACKENDS["native"] = lambda: NativeMemoryIndex(
+        NativeMemoryIndexConfig(size=1000, pod_cache_size=10)
+    )
+
 
 @pytest.fixture(params=list(BACKENDS))
 def index(request):
@@ -124,6 +135,129 @@ class TestIndexConformance:
         for t in threads:
             t.join()
         assert not errors
+
+
+@pytest.mark.skipif(not native_available(), reason="liblruindex.so not built")
+class TestNativeSpecifics:
+    def test_lru_key_eviction_bound(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=2, pod_cache_size=10))
+        idx.add([_k(1), _k(2), _k(3)], [_e("podA")])
+        got = idx.lookup([_k(1), _k(2), _k(3)], set())
+        assert _k(1) not in got
+        assert got[_k(2)] == ["podA"] and got[_k(3)] == ["podA"]
+        assert len(idx) == 2
+
+    def test_pod_lru_bound(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=10, pod_cache_size=2))
+        idx.add([_k(1)], [_e("podA")])
+        idx.add([_k(1)], [_e("podB")])
+        idx.add([_k(1)], [_e("podC")])  # podA (least recent) evicted
+        got = idx.lookup([_k(1)], set())
+        assert set(got[_k(1)]) == {"podB", "podC"}
+
+    def test_lookup_promotes_key_recency(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=2, pod_cache_size=4))
+        idx.add([_k(1), _k(2)], [_e("podA")])
+        idx.lookup([_k(1)], set())  # key 1 now most recent
+        idx.add([_k(3)], [_e("podA")])  # evicts key 2, not key 1
+        got = idx.lookup([_k(1), _k(2), _k(3)], set())
+        assert _k(1) in got and _k(3) in got and _k(2) not in got
+
+    def test_early_stop_on_emptied_key(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=10, pod_cache_size=4))
+        idx.add([_k(1), _k(2), _k(3)], [_e("podA")])
+        idx.add([_k(2)], [_e("podB")])
+        idx.evict(_k(2), [_e("podA")])
+        idx.evict(_k(2), [_e("podB")])  # key 2 now gone (empty → removed)
+        got = idx.lookup([_k(1), _k(2), _k(3)], set())
+        # missing key does NOT break the chain (in_memory.py semantics)
+        assert _k(1) in got and _k(3) in got
+
+    def test_mixed_model_batches(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=10, pod_cache_size=4))
+        idx.add([_k(1, "m1")], [_e("podA")])
+        idx.add([_k(1, "m2")], [_e("podB")])
+        got = idx.lookup([_k(1, "m1"), _k(1, "m2")], set())
+        assert got[_k(1, "m1")] == ["podA"]
+        assert got[_k(1, "m2")] == ["podB"]
+
+    def test_unknown_model_lookup_empty(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=10, pod_cache_size=4))
+        assert idx.lookup([_k(1, "never-seen")], set()) == {}
+
+    def test_fused_score_matches_python_pipeline(self):
+        """The C++ fused lookup+score must agree with lookup → scorer on
+        randomized hit patterns (the property the fused read path rests on)."""
+        import random
+
+        from llm_d_kv_cache_manager_tpu.kvcache.scorer import LongestPrefixScorer
+
+        rng = random.Random(0)
+        scorer = LongestPrefixScorer()
+        for trial in range(50):
+            native = NativeMemoryIndex(NativeMemoryIndexConfig(size=100, pod_cache_size=8))
+            mirror = InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=8))
+            keys = [_k(i) for i in range(rng.randint(1, 12))]
+            for pod in ("podA", "podB", "podC"):
+                depth = rng.randint(0, len(keys))
+                # occasionally leave holes in the chain
+                chain = [
+                    k for i, k in enumerate(keys[:depth]) if rng.random() > 0.15
+                ]
+                if not chain:
+                    continue
+                for idx in (native, mirror):
+                    idx.add(chain, [_e(pod)])
+            pod_filter = rng.choice([set(), {"podA"}, {"podA", "podB"}, {"podZ"}])
+            fused = native.score_longest_prefix(keys, pod_filter)
+            expected = scorer.score(keys, mirror.lookup(keys, pod_filter))
+            assert fused == expected, (trial, fused, expected)
+
+    def test_fused_score_multi_tier_dedup(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=10, pod_cache_size=8))
+        idx.add([_k(1), _k(2)], [_e("podA", DeviceTier.TPU_HBM)])
+        idx.add([_k(1)], [_e("podA", DeviceTier.HOST_DRAM)])
+        assert idx.score_longest_prefix([_k(1), _k(2)], set()) == {"podA": 2}
+
+    def test_fused_score_promotes_past_holes(self):
+        """The fused walk must LRU-promote every present key even after the
+        scoring streak dies at a hole — identical recency behavior to the
+        two-step lookup path (regression for an early-break divergence)."""
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=2, pod_cache_size=4))
+        idx.add([_k(1), _k(2)], [_e("podA")])  # recency: k2 > k1
+        # Chain with a hole at the front, then k1: scoring yields nothing,
+        # but k1 must still be promoted over k2.
+        assert idx.score_longest_prefix([_k(99), _k(1)], set()) == {}
+        idx.add([_k(3)], [_e("podA")])  # evicts the LRU key — must be k2
+        got = idx.lookup([_k(1), _k(2), _k(3)], set())
+        assert _k(1) in got and _k(3) in got and _k(2) not in got
+
+    def test_fused_score_hits_match_two_step_semantics(self):
+        """*_with_hits reports keys-with-surviving-pods (the plain lookup
+        metric), including keys past a hole in the streak."""
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=16, pod_cache_size=4))
+        keys = [_k(i) for i in range(10)]
+        chain = keys[:2] + keys[3:]  # hole at key 2
+        idx.add(chain, [_e("podA")])
+        scores, hits = idx.score_hashes_with_hits(
+            "m", [k.chunk_hash for k in keys], set()
+        )
+        assert scores == {"podA": 2}  # streak ends at the hole
+        assert hits == 9  # but 9 of 10 keys held pods
+
+    def test_unknown_filter_pod_still_promotes(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=2, pod_cache_size=4))
+        idx.add([_k(1), _k(2)], [_e("podA")])
+        # Filter on an unknown pod: empty result, but k1 is still promoted.
+        assert idx.lookup([_k(1)], {"podZ"}) == {}
+        idx.add([_k(3)], [_e("podA")])
+        got = idx.lookup([_k(1), _k(2), _k(3)], set())
+        assert _k(1) in got and _k(2) not in got
+
+    def test_fused_score_mixed_models_falls_back(self):
+        idx = NativeMemoryIndex(NativeMemoryIndexConfig(size=10, pod_cache_size=8))
+        idx.add([_k(1, "m1")], [_e("podA")])
+        assert idx.score_longest_prefix([_k(1, "m1"), _k(1, "m2")], set()) is None
 
 
 class TestInMemorySpecifics:
