@@ -107,6 +107,20 @@ def config_from_hf(hf_config) -> LlamaConfig:
             raise NotImplementedError(
                 f"rope_scaling type {rope_type!r} is not supported yet"
             )
+    cls_name = hf_config.__class__.__name__
+    is_gemma = cls_name == "GemmaConfig"
+    if cls_name.startswith("Gemma") and not is_gemma:
+        # Gemma2/3 change the layer schema (sandwich norms, softcapping,
+        # sliding windows) — loading them as Gemma-1 would silently produce
+        # wrong logits, same policy as the rope_scaling check above.
+        raise NotImplementedError(
+            f"{cls_name} is not supported yet (Gemma-1 only)"
+        )
+    hidden_act = getattr(hf_config, "hidden_activation", None) or getattr(
+        hf_config, "hidden_act", "silu"
+    )
+    if hidden_act == "gelu_pytorch_tanh":
+        hidden_act = "gelu_tanh"
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
@@ -124,4 +138,8 @@ def config_from_hf(hf_config) -> LlamaConfig:
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         n_experts=getattr(hf_config, "num_local_experts", 0),
         n_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
+        # Gemma: gated-GELU, (1+w) norms, sqrt(d)-scaled embeddings.
+        hidden_act=hidden_act if is_gemma else "silu",
+        norm_offset=1.0 if is_gemma else 0.0,
+        scale_embeddings=is_gemma,
     )

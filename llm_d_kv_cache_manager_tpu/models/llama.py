@@ -15,10 +15,12 @@ Design (TPU-first, not a torch translation):
   float32.
 
 The architecture covers Llama 2/3 and Qwen-style GQA decoders (RMSNorm,
-RoPE, SwiGLU, optional QKV biases, optional tied embeddings) and
+RoPE, SwiGLU, optional QKV biases, optional tied embeddings),
 Mixtral-style sparse-MoE decoders (``n_experts > 0``: softmax-top-k routed
 SwiGLU experts replacing the dense FFN; attention/KV paths are identical,
-so paged serving and prefix-cache routing work unchanged).
+so paged serving and prefix-cache routing work unchanged), and the Gemma
+family (gated-GELU FFN, ``(1+w)`` RMSNorm scaling, sqrt(d)-scaled tied
+embeddings, decoupled head_dim).
 """
 
 from __future__ import annotations
@@ -84,11 +86,26 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     n_experts: int = 0  # Mixtral-style MoE FFN when > 0
     n_experts_per_tok: int = 2
+    # Gemma-style variations: gated-GELU FFN ("gelu_tanh"), (1+w) RMSNorm
+    # scaling (norm_offset=1.0), embeddings scaled by sqrt(hidden_size).
+    hidden_act: str = "silu"
+    norm_offset: float = 0.0
+    scale_embeddings: bool = False
     dtype: Any = jnp.bfloat16
 
     @property
     def hd(self) -> int:
         return self.head_dim or self.hidden_size // self.n_heads
+
+    @property
+    def act_fn(self):
+        if self.hidden_act == "silu":
+            return jax.nn.silu
+        if self.hidden_act in ("gelu_tanh", "gelu_pytorch_tanh"):
+            return functools.partial(jax.nn.gelu, approximate=True)
+        if self.hidden_act == "gelu":
+            return functools.partial(jax.nn.gelu, approximate=False)
+        raise ValueError(f"unsupported hidden_act {self.hidden_act!r}")
 
 
 #: Flagship config (meta-llama/Llama-3.1-8B, incl. its llama3 rope scaling).
@@ -149,6 +166,24 @@ MIXTRAL_8X7B = LlamaConfig(
     n_experts_per_tok=2,
 )
 
+#: google/gemma-7b: MHA (16/16) with decoupled head_dim 256, gated-GELU FFN,
+#: (1+w) RMSNorm, sqrt(d)-scaled tied embeddings.
+GEMMA_7B = LlamaConfig(
+    vocab_size=256_000,
+    hidden_size=3_072,
+    intermediate_size=24_576,
+    n_layers=28,
+    n_heads=16,
+    n_kv_heads=16,
+    head_dim=256,
+    rope_theta=10_000.0,
+    rms_norm_eps=1e-6,
+    tie_word_embeddings=True,
+    hidden_act="gelu_tanh",
+    norm_offset=1.0,
+    scale_embeddings=True,
+)
+
 #: Tiny config for tests / CPU dry-runs.
 TINY_LLAMA = LlamaConfig(
     vocab_size=256,
@@ -158,6 +193,24 @@ TINY_LLAMA = LlamaConfig(
     n_heads=4,
     n_kv_heads=2,
     rope_theta=10_000.0,
+    dtype=jnp.float32,
+)
+
+#: Tiny Gemma-shaped config for tests / CPU dry-runs.
+TINY_GEMMA = LlamaConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=4,
+    head_dim=24,
+    rope_theta=10_000.0,
+    rms_norm_eps=1e-6,
+    tie_word_embeddings=True,
+    hidden_act="gelu_tanh",
+    norm_offset=1.0,
+    scale_embeddings=True,
     dtype=jnp.float32,
 )
 
@@ -187,17 +240,21 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             cfg.dtype
         )
 
+    # Gemma's (1+w) convention stores w≈0 for an identity norm.
+    def norm_init(shape):
+        return (jnp.zeros if cfg.norm_offset else jnp.ones)(shape, cfg.dtype)
+
     keys = jax.random.split(rng, cfg.n_layers + 2)
     layers = []
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[i], 8)
         layer = {
-            "attn_norm": jnp.ones((d,), cfg.dtype),
+            "attn_norm": norm_init((d,)),
             "wq": dense(k[0], (d, n_q * hd), d),
             "wk": dense(k[1], (d, n_kv * hd), d),
             "wv": dense(k[2], (d, n_kv * hd), d),
             "wo": dense(k[3], (n_q * hd, d), n_q * hd),
-            "mlp_norm": jnp.ones((d,), cfg.dtype),
+            "mlp_norm": norm_init((d,)),
         }
         if cfg.n_experts:
             e = cfg.n_experts
@@ -214,13 +271,13 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             layer["bk"] = jnp.zeros((n_kv * hd,), cfg.dtype)
             layer["bv"] = jnp.zeros((n_kv * hd,), cfg.dtype)
         if cfg.qk_norm:
-            layer["q_norm"] = jnp.ones((hd,), cfg.dtype)
-            layer["k_norm"] = jnp.ones((hd,), cfg.dtype)
+            layer["q_norm"] = norm_init((hd,))
+            layer["k_norm"] = norm_init((hd,))
         layers.append(layer)
 
     params: Params = {
         "embed": dense(keys[-2], (cfg.vocab_size, d), d),
-        "final_norm": jnp.ones((d,), cfg.dtype),
+        "final_norm": norm_init((d,)),
         "layers": layers,
     }
     if not cfg.tie_word_embeddings:
@@ -248,8 +305,8 @@ def _qkv(layer: Params, cfg: LlamaConfig, x: jnp.ndarray):
     k = k.reshape(b, s, cfg.n_kv_heads, cfg.hd)
     v = v.reshape(b, s, cfg.n_kv_heads, cfg.hd)
     if cfg.qk_norm:
-        q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+        q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps, cfg.norm_offset)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     return q, k, v
 
 
@@ -286,13 +343,20 @@ def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
 def _mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.n_experts:
         return _moe_mlp(layer, cfg, x)
-    gate = jax.nn.silu((x @ layer["w_gate"]).astype(jnp.float32))
+    gate = cfg.act_fn((x @ layer["w_gate"]).astype(jnp.float32))
     up = (x @ layer["w_up"]).astype(jnp.float32)
     return ((gate * up).astype(x.dtype)) @ layer["w_down"]
 
 
+def _embed(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    h = params["embed"][tokens]
+    if cfg.scale_embeddings:  # Gemma: normalizer folded out of the table
+        h = h * jnp.asarray(cfg.hidden_size**0.5, h.dtype)
+    return h
+
+
 def _logits(params: Params, cfg: LlamaConfig, h: jnp.ndarray) -> jnp.ndarray:
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     return (h @ head).astype(jnp.float32)
 
@@ -346,12 +410,12 @@ def prefill(
     pass ``ctx_lens = 0``.
     """
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
-    h = params["embed"][tokens]  # [b, s, d]
+    h = _embed(params, cfg, tokens)  # [b, s, d]
 
     new_k_pages = []
     new_v_pages = []
     for li, layer in enumerate(params["layers"]):
-        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         q, k, v = _qkv(layer, cfg, x)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
@@ -363,7 +427,7 @@ def prefill(
         b, s, _, _ = attn.shape
         h = h + attn.reshape(b, s, -1) @ layer["wo"]
 
-        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         h = h + _mlp(layer, cfg, x)
 
         new_k_pages.append(
@@ -401,7 +465,7 @@ def _decode_body(
     (logits [b, vocab], k_pages, v_pages)."""
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     b = tokens.shape[0]
-    h = params["embed"][tokens][:, None, :]  # [b, 1, d]
+    h = _embed(params, cfg, tokens)[:, None, :]  # [b, 1, d]
 
     # This token's page/slot from its position.
     page_of_pos = positions // page_size  # index into block table
@@ -412,7 +476,7 @@ def _decode_body(
     new_k_pages = []
     new_v_pages = []
     for li, layer in enumerate(params["layers"]):
-        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         q, k, v = _qkv(layer, cfg, x)
         q = apply_rope(q, positions[:, None], inv_freq)
         k = apply_rope(k, positions[:, None], inv_freq)
@@ -437,7 +501,7 @@ def _decode_body(
         )  # [b, n_heads, hd]
         h = h + (attn.reshape(b, -1) @ layer["wo"])[:, None, :]
 
-        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         h = h + _mlp(layer, cfg, x)
 
     return (

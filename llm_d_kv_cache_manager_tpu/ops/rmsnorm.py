@@ -11,14 +11,22 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    offset: float = 0.0,
+) -> jnp.ndarray:
     """Root-mean-square layer norm (Llama-style, no mean subtraction).
 
     Statistics are computed in float32 regardless of input dtype (matches
     reference implementations' numerics), output cast back to input dtype.
+    ``offset`` implements Gemma's ``(1 + w)`` scaling convention (the HF
+    checkpoint stores ``w``; the model applies ``1 + w``).
     """
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    scale = weight.astype(jnp.float32) + offset
+    return (normed * scale).astype(dtype)

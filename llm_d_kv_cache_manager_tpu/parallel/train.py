@@ -34,17 +34,17 @@ def _forward_logits(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jn
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
-    h = params["embed"][tokens]
+    h = llama._embed(params, cfg, tokens)
     for layer in params["layers"]:
-        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         q, k, v = llama._qkv(layer, cfg, x)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         attn = causal_prefill_attention(q, k, v)
         h = h + attn.reshape(b, s, -1) @ layer["wo"]
-        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         h = h + llama._mlp(layer, cfg, x)
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     return (h @ head).astype(jnp.float32)
 

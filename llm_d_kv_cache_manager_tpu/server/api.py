@@ -87,6 +87,17 @@ class ScoringService:
         )
 
         use_native = cfg.native_index and native_available()
+        if cfg.native_index and not use_native:
+            log.warning(
+                "native index requested but liblruindex.so is not built — "
+                "falling back to the pure-Python index (~4x slower hot RPC); "
+                "run `python -m llm_d_kv_cache_manager_tpu.native.build`"
+            )
+        else:
+            log.info(
+                "index backend selected",
+                backend="native" if use_native else "in_memory",
+            )
         return IndexConfig(
             native_memory=NativeMemoryIndexConfig() if use_native else None,
             in_memory=None if use_native else IndexConfig().in_memory,

@@ -363,6 +363,8 @@ def _resolve_model(name: str) -> LlamaConfig:
         "Qwen/Qwen2.5-0.5B-Instruct": models.QWEN2_5_0_5B,
         "Qwen/Qwen3-32B": models.QWEN3_32B,
         "mistralai/Mixtral-8x7B-Instruct-v0.1": models.MIXTRAL_8X7B,
+        "google/gemma-7b": models.GEMMA_7B,
+        "tiny-gemma": models.TINY_GEMMA,
     }
     if name in presets:
         return presets[name]

@@ -513,6 +513,39 @@ class TestHostDramOffloadTier:
         assert s.error is None and len(s.output_tokens) == 2
 
 
+class TestGemmaServing:
+    """Gemma family through the full engine: the (1+w)-norm / gated-GELU /
+    scaled-embedding variations must survive continuous batching, prefix
+    caching, and tensor parallelism unchanged."""
+
+    def test_gemma_greedy_matches_single_chip(self):
+        from llm_d_kv_cache_manager_tpu.models import TINY_GEMMA
+
+        prompts = [_prompt(80 + i, 10 + i) for i in range(2)]
+        outs = []
+        for tp in (1, 2):
+            eng = _engine(tp=tp, model=TINY_GEMMA)
+            seqs = [
+                eng.add_request(p, SamplingParams(max_new_tokens=5))
+                for p in prompts
+            ]
+            eng.run_until_complete()
+            outs.append([s.output_tokens for s in seqs])
+        assert outs[0] == outs[1]
+
+    def test_gemma_prefix_cache_hit(self):
+        from llm_d_kv_cache_manager_tpu.models import TINY_GEMMA
+
+        p = _prompt(90, 16)
+        eng = _engine(model=TINY_GEMMA)
+        a = eng.add_request(p, SamplingParams(max_new_tokens=5))
+        eng.run_until_complete()
+        b = eng.add_request(p, SamplingParams(max_new_tokens=5))
+        eng.run_until_complete()
+        assert b.num_cached_prompt > 0
+        assert a.output_tokens == b.output_tokens
+
+
 class TestMoEServing:
     """Mixtral-style MoE model through the full engine: continuous batching,
     prefix cache, and expert-parallel TP must all preserve greedy output."""

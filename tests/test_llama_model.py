@@ -140,6 +140,89 @@ class TestHFNumericsParity:
             np.asarray(logits), hf_logits[:, -1], rtol=2e-4, atol=2e-4
         )
 
+    def test_gemma_matches_transformers(self):
+        """Gemma family: gated-GELU FFN, (1+w) RMSNorm, sqrt(d)-scaled tied
+        embeddings, decoupled head_dim — prefill AND decode logits must match
+        HF GemmaForCausalLM."""
+        torch = pytest.importorskip("torch")
+        from transformers import GemmaConfig, GemmaForCausalLM
+
+        from llm_d_kv_cache_manager_tpu.models.hf_loader import (
+            config_from_hf,
+            load_hf_state_dict,
+        )
+
+        hf_cfg = GemmaConfig(
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=4,
+            head_dim=24,
+            rope_theta=10000.0,
+            rms_norm_eps=1e-6,
+            tie_word_embeddings=True,
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        torch.manual_seed(5)
+        hf_model = GemmaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg)
+        assert cfg.norm_offset == 1.0 and cfg.scale_embeddings
+        assert cfg.hidden_act == "gelu_tanh" and cfg.tie_word_embeddings
+        cfg = LlamaConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+        params = load_hf_state_dict(hf_model.state_dict(), cfg)
+
+        batch, seq = 2, 12
+        rng = np.random.default_rng(6)
+        tokens = rng.integers(0, 128, (batch, seq))
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()
+
+        k_pages, v_pages, block_tables = _alloc(cfg, batch, seq + PAGE_SIZE)
+        pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+        logits, k_pages, v_pages = prefill(
+            params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+            k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), hf_logits[:, -1], rtol=2e-4, atol=2e-4
+        )
+
+        nxt = rng.integers(0, 128, (batch, 1))
+        with torch.no_grad():
+            hf_logits2 = hf_model(
+                torch.tensor(np.concatenate([tokens, nxt], axis=1))
+            ).logits.numpy()
+        dec_logits, _, _ = decode_step(
+            params, cfg,
+            jnp.asarray(nxt[:, 0], jnp.int32),
+            jnp.full((batch,), seq, jnp.int32),
+            k_pages, v_pages, block_tables,
+            jnp.full((batch,), seq + 1, jnp.int32),
+            page_size=PAGE_SIZE, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(dec_logits), hf_logits2[:, -1], rtol=2e-4, atol=2e-4
+        )
+
+    def test_gemma2_rejected_loudly(self):
+        """Gemma2/3 layer schemas differ; loading them as Gemma-1 must raise
+        instead of silently producing wrong logits."""
+        pytest.importorskip("torch")
+        try:
+            from transformers import Gemma2Config
+        except ImportError:
+            pytest.skip("transformers has no Gemma2Config")
+        from llm_d_kv_cache_manager_tpu.models.hf_loader import config_from_hf
+
+        with pytest.raises(NotImplementedError, match="Gemma2"):
+            config_from_hf(Gemma2Config(vocab_size=64, hidden_size=32,
+                                        intermediate_size=64,
+                                        num_hidden_layers=1,
+                                        num_attention_heads=2,
+                                        num_key_value_heads=2))
+
     def test_qwen3_qk_norm_matches_transformers(self):
         torch = pytest.importorskip("torch")
         from transformers import Qwen3Config, Qwen3ForCausalLM
