@@ -138,8 +138,9 @@ def config_from_hf(hf_config) -> LlamaConfig:
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         n_experts=getattr(hf_config, "num_local_experts", 0),
         n_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
-        # Gemma: gated-GELU, (1+w) norms, sqrt(d)-scaled embeddings.
-        hidden_act=hidden_act if is_gemma else "silu",
+        # Passed through for every family; LlamaConfig.act_fn fails loud on
+        # unsupported strings rather than silently running the wrong FFN.
+        hidden_act=hidden_act,
         norm_offset=1.0 if is_gemma else 0.0,
         scale_embeddings=is_gemma,
     )

@@ -1,0 +1,105 @@
+"""Checkpoint save/restore for model weights and train state (orbax).
+
+Scope note: the reference keeps its routing index intentionally ephemeral
+(``docs/architecture.md:127`` there — persistence/HA is the Redis backend),
+and this framework preserves that. Checkpointing here is for the *serving/
+training* side the reference never had: model parameters and optimizer
+state, saved as sharding-agnostic pytrees and restorable directly onto a
+multi-chip ``Mesh`` (each host reads only its shard — no full-model host
+gather on restore).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from ..models.llama import LlamaConfig, Params
+from ..utils import get_logger
+from .sharding import param_shardings
+from .train import TrainState
+
+log = get_logger("parallel.checkpoint")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_params(path: str, params: Params) -> None:
+    """Save a parameter pytree. Works for sharded arrays — each host writes
+    its own shards (orbax handles the coordination)."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(path), params)
+    ckptr.wait_until_finished()
+    log.info("saved params", path=path)
+
+
+def load_params(
+    path: str,
+    cfg: Optional[LlamaConfig] = None,
+    mesh=None,
+) -> Params:
+    """Restore a parameter pytree.
+
+    With ``cfg`` + ``mesh`` the restore targets the Megatron partition specs
+    from ``parallel/sharding.py``: every array lands on-device already
+    sharded (no host round-trip through a replicated copy).
+    """
+    ckptr = _checkpointer()
+    path = os.path.abspath(path)
+    if cfg is None or mesh is None:
+        return ckptr.restore(path)
+    # Abstract arrays carrying the target shardings: orbax reads each shard
+    # straight into its device placement. Shapes/dtypes come from tracing
+    # init_params (no compute), keeping this independent of orbax's
+    # metadata API shape.
+    from ..models.llama import init_params
+
+    shardings = param_shardings(mesh, cfg)
+    abstract_params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg)
+    )
+    abstract = jax.tree.map(
+        lambda m, s: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=s),
+        abstract_params,
+        shardings,
+    )
+    return ckptr.restore(path, abstract)
+
+
+def save_train_state(path: str, state: TrainState) -> None:
+    ckptr = _checkpointer()
+    ckptr.save(
+        os.path.abspath(path),
+        {"params": state.params, "opt_state": state.opt_state, "step": state.step},
+    )
+    ckptr.wait_until_finished()
+    log.info("saved train state", path=path, step=int(state.step))
+
+
+def load_train_state(path: str, cfg: LlamaConfig, lr: float = 1e-4) -> TrainState:
+    """Restore a train state. ``cfg``/``lr`` rebuild the optimizer pytree
+    structure (optax NamedTuples) that a structureless restore would flatten
+    into plain dicts."""
+    from .train import make_train_state
+
+    ckptr = _checkpointer()
+    template = jax.eval_shape(
+        lambda: make_train_state(cfg, jax.random.PRNGKey(0), lr)
+    )
+    tree = ckptr.restore(
+        os.path.abspath(path),
+        {
+            "params": template.params,
+            "opt_state": template.opt_state,
+            "step": template.step,
+        },
+    )
+    return TrainState(
+        params=tree["params"], opt_state=tree["opt_state"], step=tree["step"]
+    )

@@ -280,3 +280,58 @@ class TestZMQEndToEnd:
             sub.shutdown()
             pool.shutdown()
             indexer.shutdown()
+
+
+class TestZMQReconnect:
+    """Failure-detection parity (SURVEY §5): the subscriber reconnects with
+    backoff after socket errors — here the endpoint is initially occupied by
+    another socket (bind fails repeatedly) and the subscriber must recover
+    and deliver events once the port frees up."""
+
+    def test_recovers_after_bind_conflict(self, monkeypatch):
+        import zmq
+
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents import zmq_subscriber
+
+        monkeypatch.setattr(zmq_subscriber, "_RECONNECT_BACKOFF_S", 0.1)
+
+        port = 15573
+        ctx = zmq.Context.instance()
+        squatter = ctx.socket(zmq.PUB)
+        squatter.bind(f"tcp://*:{port}")
+
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+            InMemoryIndex,
+            InMemoryIndexConfig,
+            Key,
+        )
+
+        index = InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=4))
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        sub = ZMQSubscriber(pool, ZMQSubscriberConfig(endpoint=f"tcp://*:{port}"))
+        sub.start()
+        try:
+            time.sleep(0.5)  # a few failed bind/backoff cycles
+            squatter.close(linger=0)
+
+            pub = ZMQPublisher(
+                ZMQPublisherConfig(
+                    endpoint=f"tcp://localhost:{port}",
+                    pod_identifier="pod-r",
+                    model_name=MODEL,
+                )
+            )
+            deadline = time.time() + 20
+            found = {}
+            while time.time() < deadline and not found:
+                pub.publish(
+                    [BlockStored(block_hashes=[7], token_ids=[], block_size=4)]
+                )
+                time.sleep(0.2)
+                found = index.lookup([Key(MODEL, 7)], set())
+            pub.close()
+            assert found.get(Key(MODEL, 7)) == ["pod-r"]
+        finally:
+            sub.shutdown()
+            pool.shutdown()
