@@ -121,7 +121,7 @@ def config_from_hf(hf_config) -> LlamaConfig:
     )
     if hidden_act == "gelu_pytorch_tanh":
         hidden_act = "gelu_tanh"
-    return LlamaConfig(
+    cfg = LlamaConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         intermediate_size=hf_config.intermediate_size,
@@ -138,9 +138,11 @@ def config_from_hf(hf_config) -> LlamaConfig:
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         n_experts=getattr(hf_config, "num_local_experts", 0),
         n_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
-        # Passed through for every family; LlamaConfig.act_fn fails loud on
-        # unsupported strings rather than silently running the wrong FFN.
+        # Passed through for every family; validated below so an unsupported
+        # activation fails at load time, not on the first request.
         hidden_act=hidden_act,
         norm_offset=1.0 if is_gemma else 0.0,
         scale_embeddings=is_gemma,
     )
+    cfg.act_fn  # raises ValueError for unsupported activations
+    return cfg

@@ -334,7 +334,7 @@ def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
         jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32) * topv[..., None],
         axis=-2,
     )
-    gate = jax.nn.silu(jnp.einsum("bsd,edf->ebsf", x, layer["w_gate"]).astype(jnp.float32))
+    gate = cfg.act_fn(jnp.einsum("bsd,edf->ebsf", x, layer["w_gate"]).astype(jnp.float32))
     up = jnp.einsum("bsd,edf->ebsf", x, layer["w_up"]).astype(jnp.float32)
     act = (gate * up).astype(x.dtype)
     return jnp.einsum("ebsf,efd,bse->bsd", act, layer["w_down"], gates.astype(x.dtype))

@@ -82,22 +82,70 @@ def save_train_state(path: str, state: TrainState) -> None:
     log.info("saved train state", path=path, step=int(state.step))
 
 
-def load_train_state(path: str, cfg: LlamaConfig, lr: float = 1e-4) -> TrainState:
+def load_train_state(
+    path: str,
+    cfg: LlamaConfig,
+    mesh=None,
+    lr: float = 1e-4,
+) -> TrainState:
     """Restore a train state. ``cfg``/``lr`` rebuild the optimizer pytree
     structure (optax NamedTuples) that a structureless restore would flatten
-    into plain dicts."""
+    into plain dicts.
+
+    With ``mesh``, params restore onto the Megatron partition specs and the
+    optimizer moments onto the shardings GSPMD propagates through
+    ``optimizer.init`` from those specs — so resume is shard-direct for the
+    full ~4× model-size state, not just the weights.
+    """
     from .train import make_train_state
 
     ckptr = _checkpointer()
     template = jax.eval_shape(
         lambda: make_train_state(cfg, jax.random.PRNGKey(0), lr)
     )
+    abstract_params = template.params
+    abstract_opt = template.opt_state
+    abstract_step = template.step
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.tree_util import tree_map_with_path
+
+        pshard = param_shardings(mesh, cfg)
+        replicated = NamedSharding(mesh, P())
+        abstract_params = jax.tree.map(
+            lambda m, s: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=s),
+            template.params,
+            pshard,
+        )
+
+        def _opt_sharding(path, meta):
+            # adamw moments (mu/nu) mirror the params tree exactly; the path
+            # suffix below the mu/nu node indexes straight into pshard.
+            names = [getattr(k, "name", None) for k in path]
+            if "mu" in names or "nu" in names:
+                idx = max(
+                    i for i, n in enumerate(names) if n in ("mu", "nu")
+                )
+                sub = pshard
+                for k in path[idx + 1 :]:
+                    sub = sub[k.key if hasattr(k, "key") else k.idx]
+                return jax.ShapeDtypeStruct(meta.shape, meta.dtype, sharding=sub)
+            return jax.ShapeDtypeStruct(
+                meta.shape, meta.dtype, sharding=replicated
+            )
+
+        abstract_opt = tree_map_with_path(_opt_sharding, template.opt_state)
+        abstract_step = jax.ShapeDtypeStruct(
+            template.step.shape,
+            template.step.dtype,
+            sharding=NamedSharding(mesh, P()),
+        )
     tree = ckptr.restore(
         os.path.abspath(path),
         {
-            "params": template.params,
-            "opt_state": template.opt_state,
-            "step": template.step,
+            "params": abstract_params,
+            "opt_state": abstract_opt,
+            "step": abstract_step,
         },
     )
     return TrainState(

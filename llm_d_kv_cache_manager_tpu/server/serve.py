@@ -44,6 +44,55 @@ from .sequence import SamplingParams, Sequence
 log = get_logger("server.serve")
 
 
+class _ServingMetrics:
+    """Prometheus serving metrics (the pod-side analogue of the indexer's
+    collector): request/token counters, prefix-cache savings, TTFT histogram.
+    Inert when prometheus_client is unavailable."""
+
+    def __init__(self):
+        try:
+            import prometheus_client as prom
+        except ImportError:  # pragma: no cover
+            self._prom = None
+            return
+        self._prom = prom
+        self.registry = prom.CollectorRegistry()
+        self.requests = prom.Counter(
+            "tpu_pod_requests_total", "Completed requests", registry=self.registry
+        )
+        self.generated = prom.Counter(
+            "tpu_pod_generated_tokens_total",
+            "Generated tokens",
+            registry=self.registry,
+        )
+        self.cached_prompt = prom.Counter(
+            "tpu_pod_cached_prompt_tokens_total",
+            "Prompt tokens served from the prefix cache",
+            registry=self.registry,
+        )
+        self.ttft = prom.Histogram(
+            "tpu_pod_ttft_seconds",
+            "Time to first token",
+            registry=self.registry,
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30),
+        )
+
+    def observe_finished(self, seq: Sequence) -> None:
+        if self._prom is None:
+            return
+        self.requests.inc()
+        self.generated.inc(seq.num_generated)
+        if seq.num_cached_prompt:
+            self.cached_prompt.inc(seq.num_cached_prompt)
+        if seq.ttft is not None:
+            self.ttft.observe(seq.ttft)
+
+    def exposition(self) -> Optional[bytes]:
+        if self._prom is None:
+            return None
+        return self._prom.generate_latest(self.registry)
+
+
 def _env_bool(name: str, default: str) -> bool:
     return os.environ.get(name, default).strip().lower() not in (
         "0",
@@ -137,6 +186,7 @@ class PodServer:
         self._work = threading.Condition(self._mu)
         self._staging: deque[tuple[list[int], Optional[SamplingParams], Future]] = deque()
         self._futures: dict[int, Future] = {}  # loop-thread-only
+        self.metrics = _ServingMetrics()
         self._running = False
         self._failed: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
@@ -201,6 +251,7 @@ class PodServer:
                 if self.engine.has_work:
                     finished = self.engine.step()
                     for seq in finished:
+                        self.metrics.observe_finished(seq)
                         fut = self._futures.pop(seq.seq_id, None)
                         if fut is not None:
                             fut.set_result(seq)
@@ -344,10 +395,19 @@ class PodServer:
             }
             return web.json_response(payload)
 
+        async def metrics(_request: web.Request) -> web.Response:
+            body = self.metrics.exposition()
+            if body is None:
+                return web.json_response(
+                    {"error": "prometheus_client not installed"}, status=501
+                )
+            return web.Response(body=body, content_type="text/plain")
+
         app = web.Application()
         app.router.add_post("/v1/completions", completions)
         app.router.add_get("/healthz", healthz)
         app.router.add_get("/stats", stats)
+        app.router.add_get("/metrics", metrics)
         return app
 
 

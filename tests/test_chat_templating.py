@@ -1,0 +1,139 @@
+"""Chat-templating processor tests with golden-output validation.
+
+The reference validates its three-language rendering bridge against vLLM's
+rendered prompt (``cgo_functions_test.go`` TestVLLMValidation, network +
+Python env required). Here the renderer IS transformers'
+``render_jinja_template`` — the same function serving engines call — so the
+goldens below are frozen outputs for a Llama-3-style template: any rendering
+drift (which would silently break hash alignment between chat scoring and
+the engine) fails these tests. No network needed: templates are embedded.
+"""
+
+import threading
+
+import pytest
+
+from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+    ChatTemplatingProcessor,
+    FetchTemplateRequest,
+    RenderRequest,
+)
+
+LLAMA3_STYLE_TPL = (
+    "{{ bos_token }}{% for message in messages %}"
+    "<|start_header_id|>{{ message['role'] }}<|end_header_id|>\n\n"
+    "{{ message['content'] }}<|eot_id|>{% endfor %}"
+    "{% if add_generation_prompt %}<|start_header_id|>assistant<|end_header_id|>\n\n{% endif %}"
+)
+
+GOLDEN = (
+    "<|begin_of_text|><|start_header_id|>system<|end_header_id|>\n\n"
+    "You are terse.<|eot_id|><|start_header_id|>user<|end_header_id|>\n\n"
+    "2+2?<|eot_id|><|start_header_id|>assistant<|end_header_id|>\n\n"
+)
+
+CONVO = [
+    {"role": "system", "content": "You are terse."},
+    {"role": "user", "content": "2+2?"},
+]
+
+
+@pytest.fixture
+def proc():
+    p = ChatTemplatingProcessor()
+    p.initialize()
+    yield p
+    p.finalize()
+
+
+class TestGoldenRendering:
+    def test_llama3_style_golden(self, proc):
+        out = proc.render_chat_template(
+            RenderRequest(
+                conversations=[CONVO],
+                chat_template=LLAMA3_STYLE_TPL,
+                template_vars={"bos_token": "<|begin_of_text|>"},
+            )
+        )
+        assert out.rendered_chats == [GOLDEN]
+
+    def test_no_generation_prompt(self, proc):
+        out = proc.render_chat_template(
+            RenderRequest(
+                conversations=[CONVO],
+                chat_template=LLAMA3_STYLE_TPL,
+                add_generation_prompt=False,
+                template_vars={"bos_token": "<|begin_of_text|>"},
+            )
+        )
+        assert out.rendered_chats[0] == GOLDEN.rsplit(
+            "<|start_header_id|>assistant", 1
+        )[0]
+
+    def test_multiple_conversations_batched(self, proc):
+        convo2 = [{"role": "user", "content": "hi"}]
+        out = proc.render_chat_template(
+            RenderRequest(
+                conversations=[CONVO, convo2],
+                chat_template=LLAMA3_STYLE_TPL,
+                template_vars={"bos_token": "<|begin_of_text|>"},
+            )
+        )
+        assert len(out.rendered_chats) == 2
+        assert out.rendered_chats[0] == GOLDEN
+        assert "hi" in out.rendered_chats[1]
+
+    def test_long_conversation(self, proc):
+        """Reference tests long conversations through the bridge; rendering
+        must stay linear and lossless."""
+        convo = []
+        for i in range(100):
+            convo.append({"role": "user", "content": f"message {i}"})
+            convo.append({"role": "assistant", "content": f"reply {i}"})
+        out = proc.render_chat_template(
+            RenderRequest(
+                conversations=[convo],
+                chat_template=LLAMA3_STYLE_TPL,
+                template_vars={"bos_token": ""},
+            )
+        )
+        rendered = out.rendered_chats[0]
+        assert rendered.count("<|eot_id|>") == 200
+        assert "message 99" in rendered and "reply 99" in rendered
+
+
+class TestTemplateCache:
+    def test_explicit_template_bypasses_cache(self, proc):
+        tpl, vars_ = proc.fetch_chat_template(
+            FetchTemplateRequest(model="any", chat_template=LLAMA3_STYLE_TPL)
+        )
+        assert tpl == LLAMA3_STYLE_TPL and vars_ == {}
+
+    def test_clear_caches(self, proc):
+        proc._template_cache["k"] = ("t", {})
+        proc.clear_caches()
+        assert proc._template_cache == {}
+
+    def test_concurrent_rendering(self, proc):
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(10):
+                    out = proc.render_chat_template(
+                        RenderRequest(
+                            conversations=[CONVO],
+                            chat_template=LLAMA3_STYLE_TPL,
+                            template_vars={"bos_token": "<|begin_of_text|>"},
+                        )
+                    )
+                    assert out.rendered_chats == [GOLDEN]
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors

@@ -266,6 +266,21 @@ class TestHTTP:
         with pytest.raises(Exception):
             fut.result(timeout=5)
 
+    def test_prometheus_metrics(self):
+        async def scenario(c, server, pub):
+            await c.post(
+                "/v1/completions",
+                json={"prompt_token_ids": _prompt(8, 10), "max_tokens": 3},
+            )
+            resp = await c.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+            assert "tpu_pod_requests_total 1.0" in text
+            assert "tpu_pod_generated_tokens_total 3.0" in text
+            assert "tpu_pod_ttft_seconds_count 1.0" in text
+
+        self._run(scenario)
+
     def test_healthz_and_stats(self):
         async def scenario(c, server, pub):
             resp = await c.get("/healthz")
