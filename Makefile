@@ -1,0 +1,55 @@
+# Dev entry points (parity with the reference's Makefile targets:
+# build / unit-test / e2e-test / bench).
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: all native test unit-test e2e-test demo bench bench-smoke routing-bench \
+        engine-bench dryrun docker lint
+
+all: native test
+
+## Build the C++ kernels (hash chain + block index).
+native:
+	$(PY) -m llm_d_kv_cache_manager_tpu.native.build
+
+## Full test suite (CPU, virtual 8-device mesh via tests/conftest.py).
+test:
+	$(PY) -m pytest tests/ -q
+
+unit-test:
+	$(PY) -m pytest tests/ -q -k "not e2e and not pod_server"
+
+e2e-test:
+	$(PY) -m pytest tests/test_e2e_redis.py tests/test_kvevents.py tests/test_pod_server.py -q
+
+## End-to-end demos (no cluster needed).
+demo:
+	$(CPU_ENV) $(PY) examples/offline_events_demo.py
+	$(CPU_ENV) $(PY) examples/kv_cache_index_demo.py
+	$(CPU_ENV) $(PY) examples/kv_cache_aware_scorer.py
+	$(CPU_ENV) $(PY) examples/fleet_demo.py
+
+## Headline routing benchmark (TPU; smoke variant runs anywhere).
+bench:
+	$(PY) bench.py
+
+bench-smoke:
+	BENCH_SMOKE=1 $(PY) bench.py
+
+routing-bench:
+	$(PY) benchmarking/bench_routing.py
+
+engine-bench:
+	$(PY) benchmarking/bench_engine.py
+
+## Multi-chip dry-run on a virtual 8-device CPU mesh.
+dryrun:
+	$(CPU_ENV) $(PY) __graft_entry__.py 8
+
+docker:
+	docker build -t kv-cache-manager-tpu:latest .
+	docker build --build-arg JAX_SPEC='jax[tpu]' -t kv-cache-manager-tpu:tpu .
+
+lint:
+	$(PY) -m compileall -q llm_d_kv_cache_manager_tpu tests examples

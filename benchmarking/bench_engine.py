@@ -1,0 +1,154 @@
+"""Single-chip engine throughput: prefill tok/s and steady-state decode tok/s.
+
+Complements bench.py (routing TTFT) with the absolute serving numbers the
+reference reports for its pods (output throughput, `benchmarking/*-capacity`).
+Runs the same 1.4B Llama-family bf16 config as bench.py's full mode on one
+chip; CPU gets a tiny smoke config.
+
+Run: ``python benchmarking/bench_engine.py``; one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from llm_d_kv_cache_manager_tpu.models import llama
+    from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+    from llm_d_kv_cache_manager_tpu.server import (
+        BlockManagerConfig,
+        Engine,
+        EngineConfig,
+        SamplingParams,
+        SchedulerConfig,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_cfg = LlamaConfig(
+            vocab_size=32_000,
+            hidden_size=3072,
+            intermediate_size=8192,
+            n_layers=12,
+            n_heads=24,
+            n_kv_heads=8,
+            rope_scaling=llama.LLAMA_3_8B.rope_scaling,
+            dtype=jnp.bfloat16,
+        )
+        prefill_len, decode_batch, max_new, n_reqs = 2048, 16, 128, 16
+        total_pages, page = 4096, 16
+        burst = 8
+        interpret = False
+    else:
+        model_cfg = llama.TINY_LLAMA
+        prefill_len, decode_batch, max_new, n_reqs = 64, 4, 8, 4
+        total_pages, page = 256, 16
+        burst = 2
+        interpret = True
+
+    max_len = prefill_len + max_new + page
+    cfg = EngineConfig(
+        model=model_cfg,
+        block_manager=BlockManagerConfig(total_pages=total_pages, page_size=page),
+        scheduler=SchedulerConfig(max_prefill_batch=4, max_prefill_tokens=8192),
+        max_model_len=max_len,
+        decode_batch_size=decode_batch,
+        decode_steps_per_iter=burst,
+        prefill_bucket=64,
+        prefill_ctx_bucket=-(-max_len // page),
+        interpret=interpret,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    jax.block_until_ready(params)
+    rng = np.random.default_rng(0)
+
+    def reqs():
+        return [
+            rng.integers(0, model_cfg.vocab_size, prefill_len).tolist()
+            for _ in range(n_reqs)
+        ]
+
+    # Warmup: compile prefill + decode shapes.
+    eng = Engine(cfg, params=params)
+    for r in reqs()[:2]:
+        eng.add_request(r, SamplingParams(max_new_tokens=max_new))
+    eng.run_until_complete()
+    del eng
+
+    # Prefill throughput: cold engine, time prompt processing only
+    # (max_new_tokens=1 → ~pure prefill).
+    eng = Engine(cfg, params=params)
+    batch = reqs()
+    t0 = time.perf_counter()
+    for r in batch:
+        eng.add_request(r, SamplingParams(max_new_tokens=1))
+    eng.run_until_complete()
+    dt = time.perf_counter() - t0
+    prefill_tps = n_reqs * prefill_len / dt
+    print(
+        json.dumps(
+            {
+                "metric": "prefill_throughput",
+                "value": round(prefill_tps, 1),
+                "unit": "tok/s",
+                "prefill_len": prefill_len,
+                "n_requests": n_reqs,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+    del eng
+
+    # Decode throughput: saturate the decode lanes, measure generated tok/s
+    # once prefill is done (prompts short so decode dominates). A throwaway
+    # identical round runs first so the timed region never includes XLA
+    # compilation of the decode shapes.
+    def decode_round() -> float:
+        eng = Engine(cfg, params=params)
+        short = [
+            rng.integers(0, model_cfg.vocab_size, 64).tolist()
+            for _ in range(decode_batch)
+        ]
+        for r in short:
+            eng.add_request(r, SamplingParams(max_new_tokens=max_new))
+        while eng.has_work and any(
+            s.num_generated == 0
+            for s in eng.scheduler.running + list(eng.scheduler.waiting)
+        ):
+            eng.step()
+        gen0 = sum(s.num_generated for s in eng.scheduler.running)
+        t0 = time.perf_counter()
+        eng.run_until_complete()
+        dt = time.perf_counter() - t0
+        return (decode_batch * max_new - gen0) / dt
+
+    decode_round()  # identical throwaway round: compiles every decode shape
+    decode_tps = decode_round()
+    print(
+        json.dumps(
+            {
+                "metric": "decode_throughput",
+                "value": round(decode_tps, 1),
+                "unit": "tok/s",
+                "decode_batch": decode_batch,
+                "decode_steps_per_iter": burst,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Install: ln -s ../../hooks/pre-commit.sh .git/hooks/pre-commit
+set -euo pipefail
+cd "$(git rev-parse --show-toplevel)"
+
+echo "[pre-commit] syntax check"
+python -m compileall -q llm_d_kv_cache_manager_tpu tests examples
+
+echo "[pre-commit] fast tests (routing core)"
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_token_processor.py tests/test_index_backends.py \
+    tests/test_scorer.py tests/test_kvevents.py -q -x

@@ -67,6 +67,11 @@ class EngineConfig:
     decode_steps_per_iter: int = 1
     #: prefill length bucket granularity (shape-bucketing for jit caching)
     prefill_bucket: int = 64
+    #: decode block-table width bucket (pages): the table is sized to the
+    #: longest ACTIVE context rounded up to this, not to max_model_len —
+    #: the paged-attention grid (and its per-page DMAs) then scales with
+    #: real context length instead of the worst case.
+    decode_pages_bucket: int = 16
     #: context block-table width bucket granularity for warm prefills; raise
     #: to the max pages/seq to pin one shape (fewer XLA recompiles)
     prefill_ctx_bucket: int = 4
@@ -286,6 +291,15 @@ class Engine:
             self._append_slot_or_preempt(seq)
             self.block_manager.register_full_pages(seq)
 
+    def _decode_table_width(self, seqs: list[Sequence]) -> int:
+        """Block-table width for this decode call: longest active context in
+        pages, rounded up to ``decode_pages_bucket`` for jit-cache stability
+        (a handful of compiled shapes instead of one worst-case shape that
+        DMAs max_model_len worth of pages for every sequence)."""
+        used = max((len(s.block_table) for s in seqs), default=1)
+        bucket = max(1, self.config.decode_pages_bucket)
+        return min(self.max_pages_per_seq, _round_up(used, bucket))
+
     def _run_decode(self, seqs: list[Sequence]) -> None:
         if self.config.decode_steps_per_iter > 1:
             self._run_decode_fused(seqs)
@@ -295,7 +309,7 @@ class Engine:
         tokens = np.zeros((lanes,), np.int32)
         positions = np.zeros((lanes,), np.int32)
         seq_lens = np.zeros((lanes,), np.int32)  # 0 = inactive lane
-        block_tables = np.zeros((lanes, self.max_pages_per_seq), np.int32)
+        block_tables = np.zeros((lanes, self._decode_table_width(seqs)), np.int32)
 
         for i, seq in enumerate(seqs):
             tokens[i] = seq.all_tokens[-1]
@@ -352,7 +366,7 @@ class Engine:
         tokens = np.zeros((lanes,), np.int32)
         positions = np.zeros((lanes,), np.int32)
         seq_lens = np.zeros((lanes,), np.int32)  # 0 = inactive lane
-        block_tables = np.zeros((lanes, self.max_pages_per_seq), np.int32)
+        block_tables = np.zeros((lanes, self._decode_table_width(active)), np.int32)
         temperature = np.zeros((lanes,), np.float32)
         top_k = np.zeros((lanes,), np.int32)
         top_p = np.ones((lanes,), np.float32)
