@@ -5,13 +5,15 @@ pool; at decode each sequence reads its pages via a block table. This is the
 hot op the reference ecosystem gets from vLLM's CUDA paged attention — here
 it is a TPU kernel designed for the hardware:
 
-- KV pool layout ``[n_kv_heads, total_pages, page_size, head_dim]``:
-  head-major so each (batch, kv_head) program streams contiguous
-  ``[page_size, head_dim]`` tiles (lane dim = head_dim = 128-friendly).
-- Grid ``(batch, n_kv_heads, max_pages)`` with the block table and sequence
-  lengths as scalar prefetch: the BlockSpec index_map dereferences the block
-  table so Pallas's pipeline DMAs exactly the pages each sequence owns —
-  gather without a gather op.
+- KV pool layout ``[n_kv_heads, total_pages, page_size, head_dim]``: each
+  per-head page is a contiguous ``[page_size, head_dim]`` tile (lane dim =
+  head_dim = 128-friendly); one program fetches the page for all KV heads
+  (n_kv strided tiles batched into one block transfer).
+- Grid ``(batch, max_pages)`` — every KV head of a (sequence, page) pair in
+  one program, 8× fewer grid steps than a per-head grid — with the block
+  table and sequence lengths as scalar prefetch: the BlockSpec index_map
+  dereferences the block table so Pallas's pipeline DMAs exactly the pages
+  each sequence owns — gather without a gather op.
 - Online softmax (flash-style m/l/acc scratch carried across the page axis)
   in float32; GQA handled by blocking query heads [group, head_dim] against
   one KV head.
@@ -38,21 +40,25 @@ def _decode_kernel(
     block_tables_ref,  # [batch, max_pages] int32
     seq_lens_ref,  # [batch] int32
     # blocks
-    q_ref,  # [1, 1, group, head_dim]
-    k_ref,  # [1, 1, page_size, head_dim]
-    v_ref,  # [1, 1, page_size, head_dim]
-    out_ref,  # [1, 1, group, head_dim]
+    q_ref,  # [1, n_kv, group, head_dim]
+    k_ref,  # [n_kv, 1, page_size, head_dim]
+    v_ref,  # [n_kv, 1, page_size, head_dim]
+    out_ref,  # [1, n_kv, group, head_dim]
     # scratch
-    m_ref,  # [group, 128] f32
-    l_ref,  # [group, 128] f32
-    acc_ref,  # [group, head_dim] f32
+    m_ref,  # [n_kv, group, 128] f32
+    l_ref,  # [n_kv, group, 128] f32
+    acc_ref,  # [n_kv, group, head_dim] f32
     *,
     page_size: int,
     scale: float,
 ):
+    """All KV heads of one (sequence, page) in a single program: 8× fewer
+    grid steps than a per-head grid, with the per-head ``[page_size, d]``
+    page tiles (strided across the head-major pool) batched into one block
+    transfer per K/V page set."""
     b = pl.program_id(0)
-    p = pl.program_id(2)
-    n_pages = pl.num_programs(2)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
     seq_len = seq_lens_ref[b]
 
     @pl.when(p == 0)
@@ -64,37 +70,39 @@ def _decode_kernel(
     # Only pages holding tokens < seq_len contribute.
     @pl.when(p * page_size < seq_len)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [group, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [page_size, d]
-        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)  # [n_kv, group, d]
+        k = k_ref[:, 0].astype(jnp.float32)  # [n_kv, page_size, d]
+        v = v_ref[:, 0].astype(jnp.float32)
 
+        # Batched over kv heads: [n_kv, group, page_size]
         scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [group, page_size]
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
 
         # Mask slots at/after seq_len within this page.
         token_idx = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, dimension=1
+            jnp.int32, scores.shape, dimension=2
         )
         scores = jnp.where(token_idx < seq_len, scores, _NEG_INF)
 
-        m_prev = m_ref[:, :1]  # [group, 1]
+        m_prev = m_ref[:, :, :1]  # [n_kv, group, 1]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # [group, 1]
-        probs = jnp.exp(scores - m_new)  # [group, page_size]
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)  # [n_kv, group, page_size]
 
         l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            probs, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            probs, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(p == n_pages - 1)
     def _finalize():
-        l = l_ref[:, :1]
+        l = l_ref[:, :, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # len-0 seq → zeros, not NaN
-        out_ref[0, 0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+        out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
 
 
 @functools.partial(
@@ -134,30 +142,30 @@ def paged_attention(
     block_tables = block_tables.astype(jnp.int32)
     seq_lens = seq_lens.astype(jnp.int32)
 
-    grid = (batch, n_kv_heads, max_pages)
+    grid = (batch, max_pages)
 
-    def q_index(b, h, p, bt, sl):
-        return (b, h, 0, 0)
+    def q_index(b, p, bt, sl):
+        return (b, 0, 0, 0)
 
-    def kv_index(b, h, p, bt, sl):
-        return (h, bt[b, p], 0, 0)
+    def kv_index(b, p, bt, sl):
+        return (0, bt[b, p], 0, 0)
 
-    def out_index(b, h, p, bt, sl):
-        return (b, h, 0, 0)
+    def out_index(b, p, bt, sl):
+        return (b, 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, group, head_dim), q_index),
-            pl.BlockSpec((1, 1, page_size, head_dim), kv_index),
-            pl.BlockSpec((1, 1, page_size, head_dim), kv_index),
+            pl.BlockSpec((1, n_kv_heads, group, head_dim), q_index),
+            pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
+            pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, head_dim), out_index),
+        out_specs=pl.BlockSpec((1, n_kv_heads, group, head_dim), out_index),
         scratch_shapes=[
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, head_dim), jnp.float32),
+            pltpu.VMEM((n_kv_heads, group, 128), jnp.float32),
+            pltpu.VMEM((n_kv_heads, group, 128), jnp.float32),
+            pltpu.VMEM((n_kv_heads, group, head_dim), jnp.float32),
         ],
     )
 
