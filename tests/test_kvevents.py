@@ -335,3 +335,69 @@ class TestZMQReconnect:
         finally:
             sub.shutdown()
             pool.shutdown()
+
+
+class TestDecodeFuzz:
+    """Decoder robustness: arbitrary bytes and structurally-mutated msgpack
+    must never raise — the reference drops poison pills, never crashes
+    (pool.go:175-180), and the subscriber feeds the pool raw network input."""
+
+    def test_random_bytes_never_raise(self):
+        import random
+
+        rng = random.Random(0)
+        for _ in range(500):
+            blob = bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 64)))
+            decode_event_batch(blob)  # None or EventBatch; never an exception
+
+    def test_mutated_valid_payloads_never_raise(self):
+        import random
+
+        rng = random.Random(1)
+        base = EventBatch(
+            ts=1.0,
+            events=[
+                BlockStored(block_hashes=[1, 2], token_ids=[3, 4], block_size=4),
+                BlockRemoved(block_hashes=[2]),
+            ],
+        ).to_payload()
+        for _ in range(500):
+            blob = bytearray(base)
+            for _ in range(rng.randrange(1, 4)):
+                blob[rng.randrange(len(blob))] = rng.getrandbits(8)
+            decode_event_batch(bytes(blob))
+
+    def test_structural_garbage_never_raises(self):
+        cases = [
+            [1.0, [["BlockStored"]]],                      # missing all fields
+            [1.0, [["BlockStored", "not-a-list", 1, 2, 3]]],
+            [1.0, [["BlockStored", [None], None, None, "x", None, 5]]],
+            [1.0, [["BlockRemoved", {"a": 1}]]],
+            [1.0, [[123, [1]]]],                           # non-string tag
+            [1.0, [None, 5, "str"]],                       # non-event entries
+            ["ts", []],
+            [1.0, "not-a-list"],
+            [1.0, [["BlockStored", [1], None, [1], 4, None, 42]]],  # int medium
+        ]
+        for case in cases:
+            decode_event_batch(msgpack.packb(case))
+
+    def test_fuzz_through_pool_worker(self):
+        """Same robustness at the pool level: garbage tasks never kill the
+        worker; a valid task after 200 fuzzed ones still lands."""
+        import random
+
+        rng = random.Random(2)
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        try:
+            for i in range(200):
+                blob = bytes(rng.getrandbits(8) for _ in range(rng.randrange(1, 48)))
+                pool.add_task(Message("t", f"pod-{i%3}", MODEL, blob))
+            pool.add_task(Message("t", "pod-ok", MODEL, _stored_payload([99])))
+            assert pool.drain(timeout=30)
+            got = index.lookup([Key(MODEL, 99)], set())
+            assert got[Key(MODEL, 99)] == ["pod-ok"]
+        finally:
+            pool.shutdown()
