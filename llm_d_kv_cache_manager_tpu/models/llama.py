@@ -43,7 +43,9 @@ from ..ops import (
 from ..ops.rope import RopeScalingConfig
 
 
-def _paged_attention_tp(q, kp, vp, block_tables, seq_lens, *, interpret, mesh):
+def _paged_attention_tp(
+    q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v, *, interpret, mesh
+):
     """Decode attention, head-parallel over the ``tp`` mesh axis.
 
     The Pallas kernel is a custom call GSPMD cannot partition, so under a
@@ -51,9 +53,15 @@ def _paged_attention_tp(q, kp, vp, block_tables, seq_lens, *, interpret, mesh):
     query/KV heads and computes locally — attention is embarrassingly
     parallel over heads, so no collectives are needed here (the row-parallel
     ``wo`` matmul immediately after carries the cross-shard reduction).
+
+    ``fresh_k``/``fresh_v`` ([b, n_kv, hd]) carry the current token's K/V so
+    pool writes can be deferred past attention (see ``paged_attention``).
     """
     if mesh is None:
-        return paged_attention(q, kp, vp, block_tables, seq_lens, interpret=interpret)
+        return paged_attention(
+            q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v,
+            interpret=interpret,
+        )
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import shard_map_compat
@@ -61,10 +69,13 @@ def _paged_attention_tp(q, kp, vp, block_tables, seq_lens, *, interpret, mesh):
     fn = shard_map_compat(
         functools.partial(paged_attention, interpret=interpret),
         mesh=mesh,
-        in_specs=(P(None, "tp"), P("tp"), P("tp"), P(), P()),
+        in_specs=(
+            P(None, "tp"), P("tp"), P("tp"), P(), P(),
+            P(None, "tp"), P(None, "tp"),
+        ),
         out_specs=P(None, "tp"),
     )
-    return fn(q, kp, vp, block_tables, seq_lens)
+    return fn(q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v)
 
 Params = dict[str, Any]
 
@@ -361,28 +372,24 @@ def _logits(params: Params, cfg: LlamaConfig, h: jnp.ndarray) -> jnp.ndarray:
     return (h @ head).astype(jnp.float32)
 
 
-def _scatter_kv_pages(
-    pages: jnp.ndarray,  # [n_kv, total_pages, page_size, hd]
-    fresh: jnp.ndarray,  # [b, s, n_kv, hd]
-    page_ids: jnp.ndarray,  # [b, s] destination page per token
-    slot_ids: jnp.ndarray,  # [b, s] slot within page per token
-    valid: jnp.ndarray,  # [b, s] bool — positions beyond the chunk are masked
+def _scatter_kv_pages_all_layers(
+    pages: jnp.ndarray,  # [n_layers, n_kv, total_pages, page_size, hd]
+    fresh: jnp.ndarray,  # [n_layers, b, s, n_kv, hd]
+    page_ids: jnp.ndarray,  # [b, s]
+    slot_ids: jnp.ndarray,  # [b, s]
+    valid: jnp.ndarray,  # [b, s]
 ) -> jnp.ndarray:
-    """Scatter freshly-computed K or V vectors into their pages.
-
-    One fused scatter over the flattened (page, slot) axis — XLA lowers this
-    to an efficient dynamic-update stream on TPU; no per-token host loop.
-    Invalid (padding) positions are redirected out of range and dropped by
-    the scatter's ``mode="drop"`` semantics.
-    """
-    n_kv, total_pages, page_size, hd = pages.shape
-    flat = pages.reshape(n_kv, total_pages * page_size, hd)
+    """Scatter every layer's fresh K or V into the pool with ONE update op
+    (aliased into the donated buffer; invalid positions dropped)."""
+    L, n_kv, total_pages, page_size, hd = pages.shape
+    flat = pages.reshape(L, n_kv, total_pages * page_size, hd)
     idx = (page_ids * page_size + slot_ids).reshape(-1)  # [b*s]
-    oob = total_pages * page_size  # dropped by mode="drop"
-    idx = jnp.where(valid.reshape(-1), idx, oob)
-    updates = fresh.reshape(-1, n_kv, hd).swapaxes(0, 1)  # [n_kv, b*s, hd]
-    flat = flat.at[:, idx].set(updates, mode="drop")
-    return flat.reshape(n_kv, total_pages, page_size, hd)
+    oob = total_pages * page_size
+    idx = jnp.where(valid.reshape(-1), idx, oob)  # dropped by mode="drop"
+    # [L, b, s, n_kv, hd] -> [L, n_kv, b*s, hd]
+    updates = fresh.reshape(L, -1, n_kv, hd).swapaxes(1, 2)
+    flat = flat.at[:, :, idx].set(updates, mode="drop")
+    return flat.reshape(pages.shape)
 
 
 @functools.partial(
@@ -412,8 +419,8 @@ def prefill(
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     h = _embed(params, cfg, tokens)  # [b, s, d]
 
-    new_k_pages = []
-    new_v_pages = []
+    fresh_k = []  # per-layer [b, s, n_kv, hd] — written to pages in one go
+    fresh_v = []
     for li, layer in enumerate(params["layers"]):
         x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         q, k, v = _qkv(layer, cfg, x)
@@ -430,15 +437,19 @@ def prefill(
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         h = h + _mlp(layer, cfg, x)
 
-        new_k_pages.append(
-            _scatter_kv_pages(k_pages[li], k.astype(k_pages.dtype), page_ids, slot_ids, valid)
-        )
-        new_v_pages.append(
-            _scatter_kv_pages(v_pages[li], v.astype(v_pages.dtype), page_ids, slot_ids, valid)
-        )
+        fresh_k.append(k)
+        fresh_v.append(v)
 
-    k_pages = jnp.stack(new_k_pages)
-    v_pages = jnp.stack(new_v_pages)
+    # One batched scatter over all layers into the donated pools. In-chunk
+    # attention never reads these pages (fresh K/V ride function arguments),
+    # so deferring the writes is exact — and a single aliased update avoids
+    # the full pool copy a per-layer rebuild costs.
+    k_pages = _scatter_kv_pages_all_layers(
+        k_pages, jnp.stack(fresh_k).astype(k_pages.dtype), page_ids, slot_ids, valid
+    )
+    v_pages = _scatter_kv_pages_all_layers(
+        v_pages, jnp.stack(fresh_v).astype(v_pages.dtype), page_ids, slot_ids, valid
+    )
 
     # Logits at each sequence's last valid position.
     last_idx = jnp.maximum(jnp.sum(valid.astype(jnp.int32), axis=1) - 1, 0)  # [b]
@@ -473,29 +484,26 @@ def _decode_body(
     my_slot = positions % page_size
     valid = jnp.ones((b, 1), bool)
 
-    new_k_pages = []
-    new_v_pages = []
+    fresh_k = []  # per-layer [b, 1, n_kv, hd]; written to pages in one go
+    fresh_v = []
     for li, layer in enumerate(params["layers"]):
         x = rms_norm(h, layer["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         q, k, v = _qkv(layer, cfg, x)
         q = apply_rope(q, positions[:, None], inv_freq)
         k = apply_rope(k, positions[:, None], inv_freq)
 
-        kp = _scatter_kv_pages(
-            k_pages[li], k.astype(k_pages.dtype), my_page[:, None], my_slot[:, None], valid
-        )
-        vp = _scatter_kv_pages(
-            v_pages[li], v.astype(v_pages.dtype), my_page[:, None], my_slot[:, None], valid
-        )
-        new_k_pages.append(kp)
-        new_v_pages.append(vp)
-
+        # The kernel takes the current token's K/V as arguments (pages hold
+        # only history), so the pool write happens ONCE for all layers after
+        # the loop — a single aliased scatter instead of a per-layer pool
+        # rebuild (which cost 2×pool bytes of HBM traffic per token).
         attn = _paged_attention_tp(
             q[:, 0],  # [b, n_heads, hd]
-            kp,
-            vp,
+            k_pages[li],
+            v_pages[li],
             block_tables,
             seq_lens,
+            k[:, 0],  # [b, n_kv, hd]
+            v[:, 0],
             interpret=interpret,
             mesh=mesh,
         )  # [b, n_heads, hd]
@@ -504,10 +512,21 @@ def _decode_body(
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
         h = h + _mlp(layer, cfg, x)
 
+        fresh_k.append(k)
+        fresh_v.append(v)
+
+    k_pages = _scatter_kv_pages_all_layers(
+        k_pages, jnp.stack(fresh_k).astype(k_pages.dtype),
+        my_page[:, None], my_slot[:, None], valid,
+    )
+    v_pages = _scatter_kv_pages_all_layers(
+        v_pages, jnp.stack(fresh_v).astype(v_pages.dtype),
+        my_page[:, None], my_slot[:, None], valid,
+    )
     return (
         _logits(params, cfg, h)[:, 0],
-        jnp.stack(new_k_pages),
-        jnp.stack(new_v_pages),
+        k_pages,
+        v_pages,
     )
 
 

@@ -39,27 +39,34 @@ def _decode_kernel(
     # scalar prefetch
     block_tables_ref,  # [batch, max_pages] int32
     seq_lens_ref,  # [batch] int32
-    # blocks
+    # blocks (fresh_*_ref present only when has_fresh)
     q_ref,  # [1, n_kv, group, head_dim]
     k_ref,  # [n_kv, 1, page_size, head_dim]
     v_ref,  # [n_kv, 1, page_size, head_dim]
-    out_ref,  # [1, n_kv, group, head_dim]
-    # scratch
-    m_ref,  # [n_kv, group, 128] f32
-    l_ref,  # [n_kv, group, 128] f32
-    acc_ref,  # [n_kv, group, head_dim] f32
-    *,
+    *refs,  # [fresh_k_ref, fresh_v_ref,] out_ref, m_ref, l_ref, acc_ref
     page_size: int,
     scale: float,
+    has_fresh: bool,
 ):
     """All KV heads of one (sequence, page) in a single program: 8× fewer
     grid steps than a per-head grid, with the per-head ``[page_size, d]``
     page tiles (strided across the head-major pool) batched into one block
-    transfer per K/V page set."""
+    transfer per K/V page set.
+
+    ``has_fresh``: the current token's K/V arrive as function inputs
+    ([1, n_kv, 1, d] blocks) instead of from the pages, and pages hold only
+    the ``seq_len - 1`` historical tokens. This lets the caller defer the
+    pool write until after attention — one batched scatter per step, never
+    a pool rebuild."""
+    if has_fresh:
+        fresh_k_ref, fresh_v_ref, out_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        out_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     p = pl.program_id(1)
     n_pages = pl.num_programs(1)
     seq_len = seq_lens_ref[b]
+    hist = seq_len - 1 if has_fresh else seq_len  # tokens resident in pages
 
     @pl.when(p == 0)
     def _init():
@@ -67,8 +74,8 @@ def _decode_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Only pages holding tokens < seq_len contribute.
-    @pl.when(p * page_size < seq_len)
+    # Only pages holding historical tokens contribute.
+    @pl.when(p * page_size < hist)
     def _compute():
         q = q_ref[0].astype(jnp.float32)  # [n_kv, group, d]
         k = k_ref[:, 0].astype(jnp.float32)  # [n_kv, page_size, d]
@@ -79,11 +86,11 @@ def _decode_kernel(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale
 
-        # Mask slots at/after seq_len within this page.
+        # Mask slots at/after the historical length within this page.
         token_idx = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=2
         )
-        scores = jnp.where(token_idx < seq_len, scores, _NEG_INF)
+        scores = jnp.where(token_idx < hist, scores, _NEG_INF)
 
         m_prev = m_ref[:, :, :1]  # [n_kv, group, 1]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
@@ -100,6 +107,24 @@ def _decode_kernel(
 
     @pl.when(p == n_pages - 1)
     def _finalize():
+        if has_fresh:
+            # Merge the current token's K/V (always visible to itself).
+            @pl.when(seq_len > 0)
+            def _merge_fresh():
+                q = q_ref[0].astype(jnp.float32)  # [n_kv, group, d]
+                kf = fresh_k_ref[0, :, 0].astype(jnp.float32)  # [n_kv, d]
+                vf = fresh_v_ref[0, :, 0].astype(jnp.float32)
+                s_f = (
+                    jnp.sum(q * kf[:, None, :], axis=-1, keepdims=True) * scale
+                )  # [n_kv, group, 1]
+                m_prev = m_ref[:, :, :1]
+                m_new = jnp.maximum(m_prev, s_f)
+                alpha = jnp.exp(m_prev - m_new)
+                p_f = jnp.exp(s_f - m_new)  # [n_kv, group, 1]
+                l_ref[:] = l_ref[:] * alpha + p_f
+                acc_ref[:] = acc_ref[:] * alpha + p_f * vf[:, None, :]
+                m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
         l = l_ref[:, :, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # len-0 seq → zeros, not NaN
         out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
@@ -115,6 +140,8 @@ def paged_attention(
     v_pages: jnp.ndarray,  # same
     block_tables: jnp.ndarray,  # [batch, max_pages] int32; pad slots with 0
     seq_lens: jnp.ndarray,  # [batch] int32
+    fresh_k: Optional[jnp.ndarray] = None,  # [batch, n_kv_heads, head_dim]
+    fresh_v: Optional[jnp.ndarray] = None,
     *,
     page_size: Optional[int] = None,
     scale: Optional[float] = None,
@@ -125,6 +152,11 @@ def paged_attention(
     Returns [batch, n_heads, head_dim]. ``block_tables`` entries beyond a
     sequence's page count must be valid page indices (e.g. 0); they are
     masked out, never read into the result.
+
+    With ``fresh_k``/``fresh_v``, the current token's K/V come from these
+    arguments and the pages are treated as holding only the ``seq_len - 1``
+    historical tokens — the caller may then write the pool *after*
+    attention in one batched scatter (no per-layer pool rebuild).
     """
     batch, n_heads, head_dim = q.shape
     n_kv_heads, _total, ps, _hd = k_pages.shape
@@ -137,6 +169,9 @@ def paged_attention(
         interpret = True
     group = n_heads // n_kv_heads
     max_pages = block_tables.shape[1]
+    if (fresh_k is None) != (fresh_v is None):
+        raise ValueError("fresh_k and fresh_v must be passed together")
+    has_fresh = fresh_k is not None
 
     q_blocked = q.reshape(batch, n_kv_heads, group, head_dim)
     block_tables = block_tables.astype(jnp.int32)
@@ -153,14 +188,22 @@ def paged_attention(
     def out_index(b, p, bt, sl):
         return (b, 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, n_kv_heads, group, head_dim), q_index),
+        pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
+        pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
+    ]
+    inputs = [block_tables, seq_lens, q_blocked, k_pages, v_pages]
+    if has_fresh:
+        in_specs.append(pl.BlockSpec((1, n_kv_heads, 1, head_dim), q_index))
+        in_specs.append(pl.BlockSpec((1, n_kv_heads, 1, head_dim), q_index))
+        inputs.append(fresh_k.reshape(batch, n_kv_heads, 1, head_dim))
+        inputs.append(fresh_v.reshape(batch, n_kv_heads, 1, head_dim))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, n_kv_heads, group, head_dim), q_index),
-            pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
-            pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_kv_heads, group, head_dim), out_index),
         scratch_shapes=[
             pltpu.VMEM((n_kv_heads, group, 128), jnp.float32),
@@ -169,19 +212,15 @@ def paged_attention(
         ],
     )
 
-    kernel = functools.partial(_decode_kernel, page_size=page_size, scale=scale)
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, scale=scale, has_fresh=has_fresh
+    )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((batch, n_kv_heads, group, head_dim), q.dtype),
         interpret=interpret,
-    )(
-        block_tables,
-        seq_lens,
-        q_blocked,
-        k_pages,
-        v_pages,
-    )
+    )(*inputs)
     return out.reshape(batch, n_heads, head_dim)
 
 

@@ -70,3 +70,63 @@ class TestPagedAttentionKernel:
         out = paged_attention(q, k, v, bt, sl, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
         assert float(jnp.max(jnp.abs(out))) < 100.0
+
+
+class TestFreshKV:
+    """Deferred-write contract: kernel with (history-only pages + fresh K/V
+    args) must equal the kernel with the token already written to pages."""
+
+    def test_fresh_kv_matches_written_pages(self):
+        import numpy as np
+        from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
+            paged_attention,
+            paged_attention_reference,
+        )
+
+        rng = np.random.default_rng(11)
+        b, nq, nkv, d, ps, pages, maxp = 3, 8, 4, 32, 4, 32, 6
+        q = jnp.asarray(rng.standard_normal((b, nq, d)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
+        # Distinct pages per sequence so writes don't collide.
+        bt = jnp.asarray(
+            rng.permutation(pages - 1)[: b * maxp].reshape(b, maxp) + 1, jnp.int32
+        )
+        seq_lens = jnp.asarray([1, ps + 2, 2 * ps], jnp.int32)  # incl. current
+        fk = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.float32)
+        fv = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.float32)
+
+        # Write the current token into its page slot, then run both paths.
+        kp_w, vp_w = kp, vp
+        for i in range(b):
+            pos = int(seq_lens[i]) - 1
+            page = int(bt[i, pos // ps])
+            slot = pos % ps
+            kp_w = kp_w.at[:, page, slot].set(fk[i])
+            vp_w = vp_w.at[:, page, slot].set(fv[i])
+
+        written = paged_attention(q, kp_w, vp_w, bt, seq_lens)
+        fresh = paged_attention(q, kp, vp, bt, seq_lens, fk, fv)
+        np.testing.assert_allclose(
+            np.asarray(fresh), np.asarray(written), atol=2e-5
+        )
+        # And both agree with the oracle on the written pages.
+        ref = paged_attention_reference(q, kp_w, vp_w, bt, seq_lens)
+        np.testing.assert_allclose(np.asarray(fresh), np.asarray(ref), atol=2e-5)
+
+    def test_fresh_kv_inactive_lane_zeros(self):
+        import numpy as np
+        from llm_d_kv_cache_manager_tpu.ops.paged_attention import paged_attention
+
+        rng = np.random.default_rng(12)
+        b, nq, nkv, d, ps, pages, maxp = 2, 4, 2, 32, 4, 8, 2
+        q = jnp.asarray(rng.standard_normal((b, nq, d)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
+        bt = jnp.zeros((b, maxp), jnp.int32)
+        seq_lens = jnp.asarray([3, 0], jnp.int32)  # lane 1 inactive
+        fk = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.float32)
+        fv = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.float32)
+        out = paged_attention(q, kp, vp, bt, seq_lens, fk, fv)
+        assert bool(jnp.all(out[1] == 0.0))
+        assert bool(jnp.any(out[0] != 0.0))
