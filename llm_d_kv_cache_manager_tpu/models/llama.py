@@ -380,16 +380,37 @@ def _scatter_kv_pages_all_layers(
     valid: jnp.ndarray,  # [b, s]
 ) -> jnp.ndarray:
     """Scatter every layer's fresh K or V into the pool with ONE update op
-    (aliased into the donated buffer; invalid positions dropped)."""
+    (aliased into the donated buffer; invalid positions dropped).
+
+    The scatter runs on the 5D pool directly — flattening (page, slot) via
+    reshape made XLA pick a non-default layout for the scatter chain, which
+    forced full-pool layout-conversion copies around the (default-layout)
+    Pallas attention call on every decode step."""
     L, n_kv, total_pages, page_size, hd = pages.shape
-    flat = pages.reshape(L, n_kv, total_pages * page_size, hd)
-    idx = (page_ids * page_size + slot_ids).reshape(-1)  # [b*s]
-    oob = total_pages * page_size
-    idx = jnp.where(valid.reshape(-1), idx, oob)  # dropped by mode="drop"
+    b, s = page_ids.shape
+    if s == 1:
+        # Decode: one token per lane. dynamic-update-slice per lane keeps
+        # the pool in default layout (a scatter here made XLA pick a
+        # permuted layout, forcing full-pool layout-conversion copies around
+        # the Pallas call every step). Invalid lanes write into reserved
+        # page 0 — the engine never maps it (same padded-lane semantics as
+        # the fused-decode reservation path).
+        upd = fresh[:, :, 0].swapaxes(1, 2)  # [L, n_kv, b, hd]
+        for i in range(b):
+            page = jnp.where(valid[i, 0], page_ids[i, 0], 0)
+            pages = jax.lax.dynamic_update_slice(
+                pages,
+                upd[:, :, i][:, :, None, None, :].astype(pages.dtype),
+                (0, 0, page, slot_ids[i, 0], 0),
+            )
+        return pages
+    pidx = page_ids.reshape(-1)
+    sidx = slot_ids.reshape(-1)
+    # Invalid positions: redirect the page index out of range → mode="drop".
+    pidx = jnp.where(valid.reshape(-1), pidx, total_pages)
     # [L, b, s, n_kv, hd] -> [L, n_kv, b*s, hd]
     updates = fresh.reshape(L, -1, n_kv, hd).swapaxes(1, 2)
-    flat = flat.at[:, :, idx].set(updates, mode="drop")
-    return flat.reshape(pages.shape)
+    return pages.at[:, :, pidx, sidx].set(updates, mode="drop")
 
 
 @functools.partial(
