@@ -47,19 +47,25 @@ def load_hf_state_dict(
             "mlp_norm": jnp.asarray(get(p + "post_attention_layernorm.weight"), cfg.dtype),
         }
         if cfg.n_experts:
-            # Mixtral block_sparse_moe: gate = router [E, d]; expert j's
-            # w1/w3/w2 = gate/up/down projections. Stacked to [E, d, f] /
-            # [E, f, d] for the masked-dense expert einsum.
-            moe = p + "block_sparse_moe."
+            # Expert weights stacked to [E, d, f] / [E, f, d] for the
+            # masked-dense expert einsum. Two checkpoint namings:
+            # - Mixtral: block_sparse_moe.gate + experts.j.{w1,w3,w2}
+            # - Qwen3-MoE: mlp.gate + mlp.experts.j.{gate,up,down}_proj
+            if f"{p}block_sparse_moe.gate.weight" in sd:
+                moe = p + "block_sparse_moe."
+                names = ("w1.weight", "w3.weight", "w2.weight")
+            else:
+                moe = p + "mlp."
+                names = ("gate_proj.weight", "up_proj.weight", "down_proj.weight")
             layer["router"] = linear(moe + "gate.weight")
             layer["w_gate"] = jnp.stack(
-                [linear(f"{moe}experts.{j}.w1.weight") for j in range(cfg.n_experts)]
+                [linear(f"{moe}experts.{j}.{names[0]}") for j in range(cfg.n_experts)]
             )
             layer["w_up"] = jnp.stack(
-                [linear(f"{moe}experts.{j}.w3.weight") for j in range(cfg.n_experts)]
+                [linear(f"{moe}experts.{j}.{names[1]}") for j in range(cfg.n_experts)]
             )
             layer["w_down"] = jnp.stack(
-                [linear(f"{moe}experts.{j}.w2.weight") for j in range(cfg.n_experts)]
+                [linear(f"{moe}experts.{j}.{names[2]}") for j in range(cfg.n_experts)]
             )
         else:
             layer["w_gate"] = linear(p + "mlp.gate_proj.weight")
@@ -136,8 +142,11 @@ def config_from_hf(hf_config) -> LlamaConfig:
         or hf_config.__class__.__name__.startswith("Qwen2"),
         qk_norm=hf_config.__class__.__name__.startswith("Qwen3"),
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
-        n_experts=getattr(hf_config, "num_local_experts", 0),
+        n_experts=getattr(hf_config, "num_local_experts", 0)
+        or getattr(hf_config, "num_experts", 0),
         n_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
+        moe_intermediate_size=getattr(hf_config, "moe_intermediate_size", None),
+        norm_topk_prob=getattr(hf_config, "norm_topk_prob", True),
         # Passed through for every family; validated below so an unsupported
         # activation fails at load time, not on the first request.
         hidden_act=hidden_act,
@@ -145,4 +154,22 @@ def config_from_hf(hf_config) -> LlamaConfig:
         scale_embeddings=is_gemma,
     )
     cfg.act_fn  # raises ValueError for unsupported activations
+    # Qwen3-MoE variants with partially-dense layers change the layer
+    # schema; loading them as uniform-MoE would silently produce wrong
+    # logits (same policy as the Gemma2/rope guards above).
+    if cfg.n_experts:
+        sparse_step = getattr(hf_config, "decoder_sparse_step", 1)
+        dense_layers = getattr(hf_config, "mlp_only_layers", None) or []
+        if sparse_step != 1 or dense_layers:
+            raise NotImplementedError(
+                "mixed dense/sparse decoder layers are not supported "
+                f"(decoder_sparse_step={sparse_step}, "
+                f"mlp_only_layers={list(dense_layers)})"
+            )
+        if getattr(hf_config, "shared_expert_intermediate_size", 0):
+            # Qwen2-MoE adds an always-on shared expert; loading it as
+            # routed-only would silently drop those weights.
+            raise NotImplementedError(
+                "shared-expert MoE (Qwen2-MoE style) is not supported"
+            )
     return cfg

@@ -95,8 +95,14 @@ class LlamaConfig:
     qkv_bias: bool = False  # Qwen2-style
     qk_norm: bool = False  # Qwen3-style per-head RMSNorm on q/k before RoPE
     tie_word_embeddings: bool = False
-    n_experts: int = 0  # Mixtral-style MoE FFN when > 0
+    n_experts: int = 0  # sparse-MoE FFN when > 0 (Mixtral/Qwen3-MoE style)
     n_experts_per_tok: int = 2
+    # Expert FFN width when decoupled from the dense intermediate size
+    # (Qwen3-MoE); None = same as intermediate_size (Mixtral).
+    moe_intermediate_size: Optional[int] = None
+    # Renormalize the top-k gate weights (Mixtral always; Qwen3-MoE's
+    # norm_topk_prob flag).
+    norm_topk_prob: bool = True
     # Gemma-style variations: gated-GELU FFN ("gelu_tanh"), (1+w) RMSNorm
     # scaling (norm_offset=1.0), embeddings scaled by sqrt(hidden_size).
     hidden_act: str = "silu"
@@ -107,6 +113,10 @@ class LlamaConfig:
     @property
     def hd(self) -> int:
         return self.head_dim or self.hidden_size // self.n_heads
+
+    @property
+    def moe_inter(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
 
     @property
     def act_fn(self):
@@ -225,6 +235,50 @@ TINY_GEMMA = LlamaConfig(
     dtype=jnp.float32,
 )
 
+#: Qwen3-30B-A3B (128-expert top-8 MoE with qk-norm, decoupled 768-wide
+#: experts, renormalized gates per its checkpoint config).
+#:
+#: Caveat: the masked-dense expert einsum in ``_moe_mlp`` computes every
+#: expert per token — exact, and efficient when E ≲ tp (Mixtral 8x7B), but
+#: at E=128/top-8 it streams ~16× the routed expert weights per step. A
+#: grouped top-k gather dispatch is the planned path before this preset is
+#: production-servable at speed.
+QWEN3_30B_A3B = LlamaConfig(
+    vocab_size=151_936,
+    hidden_size=2_048,
+    intermediate_size=6_144,
+    n_layers=48,
+    n_heads=32,
+    n_kv_heads=4,
+    head_dim=128,
+    rope_theta=1_000_000.0,
+    rms_norm_eps=1e-6,
+    qk_norm=True,
+    n_experts=128,
+    n_experts_per_tok=8,
+    moe_intermediate_size=768,
+    norm_topk_prob=True,
+)
+
+#: Tiny Qwen3-MoE-shaped config (qk-norm + MoE) for tests / CPU dry-runs.
+TINY_QWEN3_MOE = LlamaConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=24,
+    rope_theta=10_000.0,
+    rms_norm_eps=1e-6,
+    qk_norm=True,
+    n_experts=4,
+    n_experts_per_tok=2,
+    moe_intermediate_size=48,
+    norm_topk_prob=True,
+    dtype=jnp.float32,
+)
+
 #: Tiny MoE config (Mixtral-shaped) for tests / CPU dry-runs.
 TINY_MOE = LlamaConfig(
     vocab_size=256,
@@ -268,11 +322,11 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             "mlp_norm": norm_init((d,)),
         }
         if cfg.n_experts:
-            e = cfg.n_experts
+            e, f = cfg.n_experts, cfg.moe_inter
             layer["router"] = dense(k[7], (d, e), d)
-            layer["w_gate"] = dense(k[4], (e, d, inter), d)
-            layer["w_up"] = dense(k[5], (e, d, inter), d)
-            layer["w_down"] = dense(k[6], (e, inter, d), inter)
+            layer["w_gate"] = dense(k[4], (e, d, f), d)
+            layer["w_up"] = dense(k[5], (e, d, f), d)
+            layer["w_down"] = dense(k[6], (e, f, d), f)
         else:
             layer["w_gate"] = dense(k[4], (d, inter), d)
             layer["w_up"] = dense(k[5], (d, inter), d)
@@ -339,7 +393,8 @@ def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
     router_logits = (x @ layer["router"]).astype(jnp.float32)  # [b, s, E]
     weights = jax.nn.softmax(router_logits, axis=-1)
     topv, topi = jax.lax.top_k(weights, cfg.n_experts_per_tok)  # [b, s, k]
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    if cfg.norm_topk_prob:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     # Scatter the renormalized top-k gates back to a dense [b, s, E] mask.
     gates = jnp.sum(
         jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32) * topv[..., None],

@@ -425,6 +425,8 @@ def _resolve_model(name: str) -> LlamaConfig:
         "mistralai/Mixtral-8x7B-Instruct-v0.1": models.MIXTRAL_8X7B,
         "google/gemma-7b": models.GEMMA_7B,
         "tiny-gemma": models.TINY_GEMMA,
+        "Qwen/Qwen3-30B-A3B": models.QWEN3_30B_A3B,
+        "tiny-qwen3-moe": models.TINY_QWEN3_MOE,
     }
     if name in presets:
         return presets[name]

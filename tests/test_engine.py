@@ -576,3 +576,20 @@ class TestMoEServing:
         eng.run_until_complete()
         assert b.num_cached_prompt > 0
         assert a.output_tokens == b.output_tokens
+
+    def test_qwen3_moe_serves_with_tp(self):
+        """qk-norm + MoE + decoupled expert width through the engine: greedy
+        output stable across tensor parallelism."""
+        from llm_d_kv_cache_manager_tpu.models import TINY_QWEN3_MOE
+
+        prompts = [_prompt(95 + i, 10 + i) for i in range(2)]
+        outs = []
+        for tp in (1, 2):
+            eng = _engine(tp=tp, model=TINY_QWEN3_MOE)
+            seqs = [
+                eng.add_request(p, SamplingParams(max_new_tokens=5))
+                for p in prompts
+            ]
+            eng.run_until_complete()
+            outs.append([s.output_tokens for s in seqs])
+        assert outs[0] == outs[1]

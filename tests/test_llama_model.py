@@ -206,6 +206,76 @@ class TestHFNumericsParity:
             np.asarray(dec_logits), hf_logits2[:, -1], rtol=2e-4, atol=2e-4
         )
 
+    def test_qwen3_moe_matches_transformers(self):
+        """Qwen3-MoE: qk-norm + 128-expert-style routed FFN with decoupled
+        expert width and norm_topk_prob gating — prefill logits must match
+        HF Qwen3MoeForCausalLM (tiny random model, both gating modes)."""
+        torch = pytest.importorskip("torch")
+        try:
+            from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+        except ImportError:
+            pytest.skip("transformers has no Qwen3Moe")
+        from llm_d_kv_cache_manager_tpu.models.hf_loader import (
+            config_from_hf,
+            load_hf_state_dict,
+        )
+
+        for norm_topk in (True, False):
+            hf_cfg = Qwen3MoeConfig(
+                vocab_size=128,
+                hidden_size=64,
+                intermediate_size=128,
+                moe_intermediate_size=48,
+                num_hidden_layers=2,
+                num_attention_heads=4,
+                num_key_value_heads=2,
+                head_dim=24,
+                num_experts=4,
+                num_experts_per_tok=2,
+                norm_topk_prob=norm_topk,
+                rope_theta=10000.0,
+                rms_norm_eps=1e-6,
+                tie_word_embeddings=False,
+            )
+            torch.manual_seed(7)
+            hf_model = Qwen3MoeForCausalLM(hf_cfg).eval()
+            cfg = config_from_hf(hf_cfg)
+            assert cfg.qk_norm and cfg.n_experts == 4
+            assert cfg.moe_inter == 48 and cfg.norm_topk_prob is norm_topk
+            cfg = LlamaConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+            params = load_hf_state_dict(hf_model.state_dict(), cfg)
+
+            batch, seq = 2, 12
+            rng = np.random.default_rng(8)
+            tokens = rng.integers(0, 128, (batch, seq))
+            with torch.no_grad():
+                hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()
+            k_pages, v_pages, block_tables = _alloc(cfg, batch, seq)
+            pos, valid, page_ids, slot_ids = _prefill_args(block_tables, batch, seq)
+            logits, _, _ = prefill(
+                params, cfg, jnp.asarray(tokens, jnp.int32), pos, valid,
+                k_pages, v_pages, page_ids, slot_ids, *_zero_ctx(page_ids.shape[0]),
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits), hf_logits[:, -1], rtol=3e-4, atol=3e-4
+            )
+
+    def test_qwen3_moe_mixed_dense_rejected(self):
+        pytest.importorskip("torch")
+        try:
+            from transformers import Qwen3MoeConfig
+        except ImportError:
+            pytest.skip("transformers has no Qwen3Moe")
+        from llm_d_kv_cache_manager_tpu.models.hf_loader import config_from_hf
+
+        cfg = Qwen3MoeConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+            num_experts=2, mlp_only_layers=[0],
+        )
+        with pytest.raises(NotImplementedError, match="dense/sparse"):
+            config_from_hf(cfg)
+
     def test_gemma2_rejected_loudly(self):
         """Gemma2/3 layer schemas differ; loading them as Gemma-1 must raise
         instead of silently producing wrong logits."""
@@ -599,3 +669,21 @@ class TestMixtralMoE:
                 np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
             else:
                 assert not np.allclose(got, ref)
+
+
+class TestQwen2MoeRejection:
+    def test_shared_expert_moe_rejected(self):
+        pytest.importorskip("torch")
+        try:
+            from transformers import Qwen2MoeConfig
+        except ImportError:
+            pytest.skip("transformers has no Qwen2Moe")
+        from llm_d_kv_cache_manager_tpu.models.hf_loader import config_from_hf
+
+        cfg = Qwen2MoeConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+            num_experts=4, shared_expert_intermediate_size=64,
+        )
+        with pytest.raises(NotImplementedError, match="shared-expert"):
+            config_from_hf(cfg)
