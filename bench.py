@@ -271,8 +271,12 @@ def main() -> int:
         decode_batch_size=8,
         decode_steps_per_iter=decode_burst,
         prefill_bucket=64,
-        # Pin warm prefills to a single ctx width → one compiled shape.
+        # Pin warm prefills AND decode tables to a single width → one
+        # compiled shape each. Mid-run XLA compiles (~30-60s on this model)
+        # otherwise land in whichever pod's virtual clock hits a fresh
+        # decode width first, blowing up its tail latencies.
         prefill_ctx_bucket=-(-max_len // page),
+        decode_pages_bucket=-(-max_len // page),
         interpret=interpret,
     )
 

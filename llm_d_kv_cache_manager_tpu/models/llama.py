@@ -9,8 +9,12 @@ Design (TPU-first, not a torch translation):
   last-position logits) and ``decode_step`` (one token per sequence via the
   Pallas paged-attention kernel).
 - KV pages are function inputs/outputs (donated by the engine) with layout
-  ``[n_layers, n_kv_heads, total_pages, page_size, head_dim]`` — head-major
-  for the decode kernel's contiguous page streaming.
+  ``[n_layers, total_pages, page_size, n_kv_heads, head_dim]`` — page-major
+  with (n_kv, head_dim) minor-contiguous, so a page's full KV tile is one
+  contiguous block for the decode kernel AND the per-token write slice is
+  contiguous for the scatter (XLA keeps the default layout end to end; a
+  head-major pool forced full-pool layout-conversion copies around the
+  Pallas call).
 - Weights default to bfloat16 (MXU-native); attention/softmax accumulate in
   float32.
 
@@ -70,7 +74,7 @@ def _paged_attention_tp(
         functools.partial(paged_attention, interpret=interpret),
         mesh=mesh,
         in_specs=(
-            P(None, "tp"), P("tp"), P("tp"), P(), P(),
+            P(None, "tp"), P(None, None, "tp"), P(None, None, "tp"), P(), P(),
             P(None, "tp"), P(None, "tp"),
         ),
         out_specs=P(None, "tp"),
@@ -352,8 +356,8 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
 
 def init_kv_pages(cfg: LlamaConfig, total_pages: int, page_size: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Zeroed K and V page pools:
-    ``[n_layers, n_kv_heads, total_pages, page_size, head_dim]``."""
-    shape = (cfg.n_layers, cfg.n_kv_heads, total_pages, page_size, cfg.hd)
+    ``[n_layers, total_pages, page_size, n_kv_heads, head_dim]``."""
+    shape = (cfg.n_layers, total_pages, page_size, cfg.n_kv_heads, cfg.hd)
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
@@ -428,7 +432,7 @@ def _logits(params: Params, cfg: LlamaConfig, h: jnp.ndarray) -> jnp.ndarray:
 
 
 def _scatter_kv_pages_all_layers(
-    pages: jnp.ndarray,  # [n_layers, n_kv, total_pages, page_size, hd]
+    pages: jnp.ndarray,  # [n_layers, total_pages, page_size, n_kv, hd]
     fresh: jnp.ndarray,  # [n_layers, b, s, n_kv, hd]
     page_ids: jnp.ndarray,  # [b, s]
     slot_ids: jnp.ndarray,  # [b, s]
@@ -437,35 +441,18 @@ def _scatter_kv_pages_all_layers(
     """Scatter every layer's fresh K or V into the pool with ONE update op
     (aliased into the donated buffer; invalid positions dropped).
 
-    The scatter runs on the 5D pool directly — flattening (page, slot) via
-    reshape made XLA pick a non-default layout for the scatter chain, which
-    forced full-pool layout-conversion copies around the (default-layout)
-    Pallas attention call on every decode step."""
-    L, n_kv, total_pages, page_size, hd = pages.shape
-    b, s = page_ids.shape
-    if s == 1:
-        # Decode: one token per lane. dynamic-update-slice per lane keeps
-        # the pool in default layout (a scatter here made XLA pick a
-        # permuted layout, forcing full-pool layout-conversion copies around
-        # the Pallas call every step). Invalid lanes write into reserved
-        # page 0 — the engine never maps it (same padded-lane semantics as
-        # the fused-decode reservation path).
-        upd = fresh[:, :, 0].swapaxes(1, 2)  # [L, n_kv, b, hd]
-        for i in range(b):
-            page = jnp.where(valid[i, 0], page_ids[i, 0], 0)
-            pages = jax.lax.dynamic_update_slice(
-                pages,
-                upd[:, :, i][:, :, None, None, :].astype(pages.dtype),
-                (0, 0, page, slot_ids[i, 0], 0),
-            )
-        return pages
+    The pool's page-major layout keeps the written [n_kv, hd] slice
+    minor-contiguous, so this one scatter serves prefill AND decode in the
+    default XLA layout — the compiled graphs carry zero full-pool
+    layout-conversion copies around the Pallas attention call."""
+    L, total_pages, page_size, n_kv, hd = pages.shape
     pidx = page_ids.reshape(-1)
     sidx = slot_ids.reshape(-1)
     # Invalid positions: redirect the page index out of range → mode="drop".
     pidx = jnp.where(valid.reshape(-1), pidx, total_pages)
-    # [L, b, s, n_kv, hd] -> [L, n_kv, b*s, hd]
-    updates = fresh.reshape(L, -1, n_kv, hd).swapaxes(1, 2)
-    return pages.at[:, :, pidx, sidx].set(updates, mode="drop")
+    # [L, b, s, n_kv, hd] -> [L, b*s, n_kv, hd]
+    updates = fresh.reshape(L, -1, n_kv, hd)
+    return pages.at[:, pidx, sidx].set(updates, mode="drop")
 
 
 @functools.partial(
@@ -477,7 +464,7 @@ def prefill(
     tokens: jnp.ndarray,  # [b, s] int32, right-padded
     positions: jnp.ndarray,  # [b, s] int32 absolute positions (pad value free)
     valid: jnp.ndarray,  # [b, s] bool — False positions are fully masked
-    k_pages: jnp.ndarray,  # [n_layers, n_kv, pages, page_size, hd]
+    k_pages: jnp.ndarray,  # [n_layers, pages, page_size, n_kv, hd]
     v_pages: jnp.ndarray,
     page_ids: jnp.ndarray,  # [b, s] destination page per token
     slot_ids: jnp.ndarray,  # [b, s] destination slot per token

@@ -133,7 +133,7 @@ def prefill_with_paged_context(
     q: jnp.ndarray,  # [batch, seq, n_heads, head_dim] — the fresh chunk
     k: jnp.ndarray,  # [batch, seq, n_kv_heads, head_dim]
     v: jnp.ndarray,  # [batch, seq, n_kv_heads, head_dim]
-    k_pages: jnp.ndarray,  # [n_kv_heads, total_pages, page_size, head_dim]
+    k_pages: jnp.ndarray,  # [total_pages, page_size, n_kv_heads, head_dim]
     v_pages: jnp.ndarray,
     block_tables: jnp.ndarray,  # [batch, max_ctx_pages] int32 (pad with 0)
     ctx_lens: jnp.ndarray,  # [batch] int32 — tokens of cached context
@@ -161,13 +161,17 @@ def prefill_with_paged_context(
     group = n_q // n_kv
     if scale is None:
         scale = d**-0.5
-    max_ctx = block_tables.shape[1] * k_pages.shape[2]
+    max_ctx = block_tables.shape[1] * k_pages.shape[1]
 
     qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
 
     # Context keys/values gathered per sequence: [b, n_kv, max_ctx, d].
-    ctx_k = jnp.moveaxis(k_pages[:, block_tables], 0, 1).reshape(b, n_kv, max_ctx, d)
-    ctx_v = jnp.moveaxis(v_pages[:, block_tables], 0, 1).reshape(b, n_kv, max_ctx, d)
+    ctx_k = jnp.moveaxis(
+        k_pages[block_tables].reshape(b, max_ctx, n_kv, d), 1, 2
+    )
+    ctx_v = jnp.moveaxis(
+        v_pages[block_tables].reshape(b, max_ctx, n_kv, d), 1, 2
+    )
 
     # Virtual key sequence: [context ++ chunk]. Context keys are visible to
     # every query (they strictly precede the chunk): position -1 ≤ any

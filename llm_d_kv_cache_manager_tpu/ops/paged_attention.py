@@ -5,10 +5,12 @@ pool; at decode each sequence reads its pages via a block table. This is the
 hot op the reference ecosystem gets from vLLM's CUDA paged attention — here
 it is a TPU kernel designed for the hardware:
 
-- KV pool layout ``[n_kv_heads, total_pages, page_size, head_dim]``: each
-  per-head page is a contiguous ``[page_size, head_dim]`` tile (lane dim =
-  head_dim = 128-friendly); one program fetches the page for all KV heads
-  (n_kv strided tiles batched into one block transfer).
+- KV pool layout ``[total_pages, page_size, n_kv_heads, head_dim]``:
+  page-major, so one page's full KV tile ``[page_size, n_kv, head_dim]`` is
+  a single contiguous block (lane dim = head_dim = 128-friendly) — one
+  contiguous DMA per page, and the engine's per-token write slice
+  ``[n_kv, head_dim]`` stays minor-contiguous (default XLA layout, no
+  conversion copies).
 - Grid ``(batch, max_pages)`` — every KV head of a (sequence, page) pair in
   one program, 8× fewer grid steps than a per-head grid — with the block
   table and sequence lengths as scalar prefetch: the BlockSpec index_map
@@ -41,17 +43,16 @@ def _decode_kernel(
     seq_lens_ref,  # [batch] int32
     # blocks (fresh_*_ref present only when has_fresh)
     q_ref,  # [1, n_kv, group, head_dim]
-    k_ref,  # [n_kv, 1, page_size, head_dim]
-    v_ref,  # [n_kv, 1, page_size, head_dim]
+    k_ref,  # [1, page_size, n_kv, head_dim]
+    v_ref,  # [1, page_size, n_kv, head_dim]
     *refs,  # [fresh_k_ref, fresh_v_ref,] out_ref, m_ref, l_ref, acc_ref
     page_size: int,
     scale: float,
     has_fresh: bool,
 ):
     """All KV heads of one (sequence, page) in a single program: 8× fewer
-    grid steps than a per-head grid, with the per-head ``[page_size, d]``
-    page tiles (strided across the head-major pool) batched into one block
-    transfer per K/V page set.
+    grid steps than a per-head grid, one fully-contiguous page tile
+    ``[page_size, n_kv, d]`` per K/V DMA.
 
     ``has_fresh``: the current token's K/V arrive as function inputs
     ([1, n_kv, 1, d] blocks) instead of from the pages, and pages hold only
@@ -78,8 +79,10 @@ def _decode_kernel(
     @pl.when(p * page_size < hist)
     def _compute():
         q = q_ref[0].astype(jnp.float32)  # [n_kv, group, d]
-        k = k_ref[:, 0].astype(jnp.float32)  # [n_kv, page_size, d]
-        v = v_ref[:, 0].astype(jnp.float32)
+        # Page tile arrives [page_size, n_kv, d] (one fully-contiguous
+        # block); swap to head-major for the batched dot.
+        k = jnp.swapaxes(k_ref[0].astype(jnp.float32), 0, 1)  # [n_kv, ps, d]
+        v = jnp.swapaxes(v_ref[0].astype(jnp.float32), 0, 1)
 
         # Batched over kv heads: [n_kv, group, page_size]
         scores = jax.lax.dot_general(
@@ -111,18 +114,24 @@ def _decode_kernel(
             # Merge the current token's K/V (always visible to itself).
             @pl.when(seq_len > 0)
             def _merge_fresh():
+                # Same dot_general shapes as _compute with page_size == 1 —
+                # the current token is a one-slot virtual page.
                 q = q_ref[0].astype(jnp.float32)  # [n_kv, group, d]
-                kf = fresh_k_ref[0, :, 0].astype(jnp.float32)  # [n_kv, d]
-                vf = fresh_v_ref[0, :, 0].astype(jnp.float32)
-                s_f = (
-                    jnp.sum(q * kf[:, None, :], axis=-1, keepdims=True) * scale
-                )  # [n_kv, group, 1]
+                kf = fresh_k_ref[0].astype(jnp.float32)  # [n_kv, 1, d]
+                vf = fresh_v_ref[0].astype(jnp.float32)
+                s_f = jax.lax.dot_general(
+                    q, kf, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                ) * scale  # [n_kv, group, 1]
                 m_prev = m_ref[:, :, :1]
                 m_new = jnp.maximum(m_prev, s_f)
                 alpha = jnp.exp(m_prev - m_new)
                 p_f = jnp.exp(s_f - m_new)  # [n_kv, group, 1]
                 l_ref[:] = l_ref[:] * alpha + p_f
-                acc_ref[:] = acc_ref[:] * alpha + p_f * vf[:, None, :]
+                acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                    p_f, vf, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
                 m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
         l = l_ref[:, :, :1]
@@ -136,7 +145,7 @@ def _decode_kernel(
 )
 def paged_attention(
     q: jnp.ndarray,  # [batch, n_heads, head_dim]
-    k_pages: jnp.ndarray,  # [n_kv_heads, total_pages, page_size, head_dim]
+    k_pages: jnp.ndarray,  # [total_pages, page_size, n_kv_heads, head_dim]
     v_pages: jnp.ndarray,  # same
     block_tables: jnp.ndarray,  # [batch, max_pages] int32; pad slots with 0
     seq_lens: jnp.ndarray,  # [batch] int32
@@ -159,7 +168,7 @@ def paged_attention(
     attention in one batched scatter (no per-layer pool rebuild).
     """
     batch, n_heads, head_dim = q.shape
-    n_kv_heads, _total, ps, _hd = k_pages.shape
+    _total, ps, n_kv_heads, _hd = k_pages.shape
     page_size = ps if page_size is None else page_size
     if scale is None:
         scale = head_dim**-0.5
@@ -183,15 +192,15 @@ def paged_attention(
         return (b, 0, 0, 0)
 
     def kv_index(b, p, bt, sl):
-        return (0, bt[b, p], 0, 0)
+        return (bt[b, p], 0, 0, 0)
 
     def out_index(b, p, bt, sl):
         return (b, 0, 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, n_kv_heads, group, head_dim), q_index),
-        pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
-        pl.BlockSpec((n_kv_heads, 1, page_size, head_dim), kv_index),
+        pl.BlockSpec((1, page_size, n_kv_heads, head_dim), kv_index),
+        pl.BlockSpec((1, page_size, n_kv_heads, head_dim), kv_index),
     ]
     inputs = [block_tables, seq_lens, q_blocked, k_pages, v_pages]
     if has_fresh:
@@ -235,20 +244,20 @@ def paged_attention_reference(
 ) -> jnp.ndarray:
     """Pure-jnp oracle: gather pages per sequence, mask, softmax."""
     batch, n_heads, head_dim = q.shape
-    n_kv_heads, _, page_size, _ = k_pages.shape
+    _, page_size, n_kv_heads, _ = k_pages.shape
     group = n_heads // n_kv_heads
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = head_dim**-0.5
 
     # Gather per-sequence K/V: [batch, n_kv, max_pages*page_size, d]
-    gathered_k = k_pages[:, block_tables]  # [n_kv, batch, max_pages, ps, d]
-    gathered_v = v_pages[:, block_tables]
-    gathered_k = jnp.moveaxis(gathered_k, 0, 1).reshape(
-        batch, n_kv_heads, max_pages * page_size, head_dim
+    gathered_k = k_pages[block_tables]  # [batch, max_pages, ps, n_kv, d]
+    gathered_v = v_pages[block_tables]
+    gathered_k = jnp.moveaxis(
+        gathered_k.reshape(batch, max_pages * page_size, n_kv_heads, head_dim), 1, 2
     )
-    gathered_v = jnp.moveaxis(gathered_v, 0, 1).reshape(
-        batch, n_kv_heads, max_pages * page_size, head_dim
+    gathered_v = jnp.moveaxis(
+        gathered_v.reshape(batch, max_pages * page_size, n_kv_heads, head_dim), 1, 2
     )
 
     qf = q.astype(jnp.float32).reshape(batch, n_kv_heads, group, head_dim)

@@ -95,5 +95,5 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def kv_pages_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pools [n_layers, n_kv_heads, pages, page_size, hd]: head-parallel."""
-    return NamedSharding(mesh, P(None, "tp"))
+    """KV pools [n_layers, pages, page_size, n_kv_heads, hd]: head-parallel."""
+    return NamedSharding(mesh, P(None, None, None, "tp"))

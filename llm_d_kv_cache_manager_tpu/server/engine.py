@@ -42,15 +42,15 @@ def _round_up(n: int, multiple: int) -> int:
 
 @jax.jit
 def _read_page(pages: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """Gather one KV page [n_layers, n_kv, page_size, hd] for host offload."""
-    return jnp.take(pages, idx, axis=2)
+    """Gather one KV page [n_layers, page_size, n_kv, hd] for host offload."""
+    return jnp.take(pages, idx, axis=1)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _write_page(pages: jnp.ndarray, idx: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     """Scatter one host page back into the pool — donated, so XLA updates
     the pool in place instead of copying it."""
-    return pages.at[:, :, idx].set(data)
+    return pages.at[:, idx].set(data)
 
 
 @dataclass
@@ -140,7 +140,7 @@ class Engine:
         # Host-DRAM offload tier: numpy slot pool + jitted page movers.
         hp = config.block_manager.host_pages
         if hp > 0:
-            slot_shape = (hp, cfg.n_layers, cfg.n_kv_heads, ps, cfg.hd)
+            slot_shape = (hp, cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
             np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
             self._host_k = np.zeros(slot_shape, np_dtype)
             self._host_v = np.zeros(slot_shape, np_dtype)

@@ -14,8 +14,8 @@ from llm_d_kv_cache_manager_tpu.ops.paged_attention import (
 def _setup(seed, B, NH, NKV, D, PS, NPAGES, MAXP, dtype=jnp.float32):
     rng = np.random.default_rng(seed)
     q = jnp.array(rng.standard_normal((B, NH, D)), dtype)
-    k = jnp.array(rng.standard_normal((NKV, NPAGES, PS, D)) * 0.3, dtype)
-    v = jnp.array(rng.standard_normal((NKV, NPAGES, PS, D)), dtype)
+    k = jnp.array(rng.standard_normal((NPAGES, PS, NKV, D)) * 0.3, dtype)
+    v = jnp.array(rng.standard_normal((NPAGES, PS, NKV, D)), dtype)
     # unique pages per sequence (engine invariant: no aliasing between live seqs)
     ids = rng.permutation(NPAGES)[: B * MAXP].reshape(B, MAXP)
     bt = jnp.array(ids, jnp.int32)
@@ -86,8 +86,8 @@ class TestFreshKV:
         rng = np.random.default_rng(11)
         b, nq, nkv, d, ps, pages, maxp = 3, 8, 4, 32, 4, 32, 6
         q = jnp.asarray(rng.standard_normal((b, nq, d)), jnp.float32)
-        kp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
-        vp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((pages, ps, nkv, d)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((pages, ps, nkv, d)), jnp.float32)
         # Distinct pages per sequence so writes don't collide.
         bt = jnp.asarray(
             rng.permutation(pages - 1)[: b * maxp].reshape(b, maxp) + 1, jnp.int32
@@ -102,8 +102,8 @@ class TestFreshKV:
             pos = int(seq_lens[i]) - 1
             page = int(bt[i, pos // ps])
             slot = pos % ps
-            kp_w = kp_w.at[:, page, slot].set(fk[i])
-            vp_w = vp_w.at[:, page, slot].set(fv[i])
+            kp_w = kp_w.at[page, slot].set(fk[i])
+            vp_w = vp_w.at[page, slot].set(fv[i])
 
         written = paged_attention(q, kp_w, vp_w, bt, seq_lens)
         fresh = paged_attention(q, kp, vp, bt, seq_lens, fk, fv)
@@ -121,8 +121,8 @@ class TestFreshKV:
         rng = np.random.default_rng(12)
         b, nq, nkv, d, ps, pages, maxp = 2, 4, 2, 32, 4, 8, 2
         q = jnp.asarray(rng.standard_normal((b, nq, d)), jnp.float32)
-        kp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
-        vp = jnp.asarray(rng.standard_normal((nkv, pages, ps, d)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((pages, ps, nkv, d)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((pages, ps, nkv, d)), jnp.float32)
         bt = jnp.zeros((b, maxp), jnp.int32)
         seq_lens = jnp.asarray([3, 0], jnp.int32)  # lane 1 inactive
         fk = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.float32)
