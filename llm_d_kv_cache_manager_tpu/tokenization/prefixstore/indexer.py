@@ -16,6 +16,10 @@ class Config:
     cache_size: int = 500_000
     # Prompt bytes per block (reference lru_store.go:31).
     block_size: int = 256
+    # Trie-store node budget per model (ContainedTokenStore only; one node
+    # per prompt character, so this is a character — not block — capacity).
+    # ~1M nodes is a comparable memory footprint to the LRU defaults above.
+    trie_max_nodes: int = 1_000_000
 
 
 class Indexer(ABC):

@@ -1,14 +1,14 @@
-"""Alternative prefix store: character trie (no eviction).
+"""Alternative prefix store: character trie (bounded).
 
 Parity with reference ``pkg/tokenization/prefixstore/trie_store.go``: a
 per-model character trie where each node records the tokens that become
 fully contained once the prefix reaches that character (token ``[, high]``
 byte offset ≤ the node's byte position). Lookup walks the prompt until the
 first unseen character, collecting newly-contained tokens and the covered
-ratio. Not the default: unbounded growth and slower than the LRU store
-(reference ``docs/architecture.md:159-160``).
+ratio. Not the default: slower than the LRU store (reference
+``docs/architecture.md:159-160``).
 
-Design deviations from the reference (both correctness fixes):
+Design deviations from the reference (all three are fixes):
 
 - nodes store *all* newly-contained token ids at their position rather than
   only the last one — the reference drops intermediate tokens when several
@@ -16,12 +16,20 @@ Design deviations from the reference (both correctness fixes):
 - each insert stamps its path with a generation, and lookups stop at the
   first generation change — the reference happily splices token indexes
   from different tokenizations that overwrote each other's shared-prefix
-  nodes, returning corrupted sequences with full overlap ratio.
+  nodes, returning corrupted sequences with full overlap ratio;
+- growth is bounded (the reference grows without limit,
+  ``trie_store.go`` has no eviction): per-model node count is capped at
+  ``Config.trie_max_nodes`` by pruning stale-generation subtrees — which
+  the generation rule above already makes unreachable to lookups, so the
+  prune is lossless — then truncating the live path's tail if a single
+  tokenization alone exceeds the budget; model tries are LRU-evicted
+  beyond ``MAX_MODELS``.
 """
 
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Optional, Sequence
 
 from .indexer import Config, Indexer, Offset
@@ -45,9 +53,13 @@ class _Node:
 
 
 class ContainedTokenStore(Indexer):
+    #: model tries kept; least-recently-used beyond this are dropped whole.
+    MAX_MODELS = 64
+
     def __init__(self, config: Optional[Config] = None):
         self.config = config or Config()
-        self._tries: dict[str, _Node] = {}
+        self._tries: OrderedDict[str, _Node] = OrderedDict()
+        self._counts: dict[str, int] = {}  # nodes per model, incl. root
         self._gen = 0
         self._mu = threading.RLock()
 
@@ -56,7 +68,50 @@ class ContainedTokenStore(Indexer):
         if trie is None and create:
             trie = _Node()
             self._tries[model_name] = trie
+            self._counts[model_name] = 1
+            while len(self._tries) > self.MAX_MODELS:
+                evicted, _ = self._tries.popitem(last=False)
+                del self._counts[evicted]
+        if trie is not None:
+            self._tries.move_to_end(model_name)
         return trie
+
+    def node_count(self, model_name: str) -> int:
+        """Nodes currently held for ``model_name`` (bounded diagnostics)."""
+        with self._mu:
+            return self._counts.get(model_name, 0)
+
+    def _enforce_budget(self, model_name: str, root: _Node) -> None:
+        """Cap the model trie at ``config.trie_max_nodes`` nodes.
+
+        First prune subtrees whose generation is stale: the lookup rule
+        (stop at the first generation change from the root's) makes them
+        unreachable already, so dropping them changes no lookup result.
+        What survives is the single chain written by the latest insert; if
+        that alone exceeds the budget, truncate its tail.
+        """
+        budget = max(2, self.config.trie_max_nodes)
+        if self._counts[model_name] <= budget:
+            return
+        live_gen = root.gen
+        node = root
+        kept = 1
+        while True:
+            live = None
+            for ch, child in node.children.items():
+                if child.gen == live_gen:
+                    live = (ch, child)
+                    break  # one insert writes one path: ≤1 live child
+            if live is None:
+                node.children.clear()
+                break
+            if kept + 1 > budget:  # live path alone exceeds the budget
+                node.children.clear()
+                break
+            node.children = {live[0]: live[1]}
+            node = live[1]
+            kept += 1
+        self._counts[model_name] = kept
 
     def add_tokenization(
         self,
@@ -86,6 +141,7 @@ class ContainedTokenStore(Indexer):
             node.gen = gen
 
             byte_pos = 0
+            created = 0
             for ch in prompt:
                 byte_pos += len(ch.encode("utf-8"))
                 new_here: list[int] = []
@@ -96,10 +152,13 @@ class ContainedTokenStore(Indexer):
                 if child is None:
                     child = _Node()
                     node.children[ch] = child
+                    created += 1
                 node = child
                 node.new_tokens = new_here
                 node.last_index = k
                 node.gen = gen
+            self._counts[model_name] += created
+            self._enforce_budget(model_name, self._tries[model_name])
 
     def find_longest_contained_tokens(
         self, prompt: str, model_name: str

@@ -140,3 +140,45 @@ class TestContainedTokenStore:
         got2, ratio2 = store.find_longest_contained_tokens("abe", "m")
         assert got2 == [20, 21]
         assert ratio2 == 1.0
+
+    def test_bounded_growth_prunes_stale_paths(self):
+        # The reference trie grows without limit; this store caps nodes per
+        # model. Stale-generation subtrees (unreachable to lookups anyway)
+        # are pruned once the budget is exceeded.
+        store = ContainedTokenStore(Config(trie_max_nodes=32))
+        for i in range(100):
+            prompt = f"prompt-{i:03d}-" + "x" * 10
+            toks = list(range(len(prompt)))
+            offs = [(j, j + 1) for j in range(len(prompt))]
+            store.add_tokenization("m", prompt, toks, offs)
+            assert store.node_count("m") <= 32
+        # The most recent insert stays fully retrievable after pruning
+        # (its path is 21 chars < budget).
+        last = "prompt-099-" + "x" * 10
+        got, ratio = store.find_longest_contained_tokens(last, "m")
+        assert ratio == 1.0
+        assert got == list(range(len(last)))
+
+    def test_budget_truncates_oversized_single_path(self):
+        # One tokenization longer than the whole budget: keep a truncated
+        # prefix rather than exceeding the cap.
+        store = ContainedTokenStore(Config(trie_max_nodes=8))
+        prompt = "a" * 50
+        store.add_tokenization(
+            "m", prompt, list(range(50)), [(j, j + 1) for j in range(50)]
+        )
+        assert store.node_count("m") <= 8
+        got, ratio = store.find_longest_contained_tokens(prompt, "m")
+        assert 0 < ratio < 1.0
+        assert got == list(range(len(got)))  # a clean prefix, no gaps
+
+    def test_model_lru_eviction(self):
+        store = ContainedTokenStore()
+        n = store.MAX_MODELS
+        for i in range(n + 5):
+            store.add_tokenization(f"model-{i}", "ab", [1, 2], [(0, 1), (1, 2)])
+        assert len(store._tries) == n
+        # Oldest models evicted whole; newest retrievable.
+        assert store.find_longest_contained_tokens("ab", "model-0") == ([], 0.0)
+        got, ratio = store.find_longest_contained_tokens("ab", f"model-{n + 4}")
+        assert got == [1, 2] and ratio == 1.0
