@@ -107,6 +107,11 @@ class LlamaConfig:
     # Renormalize the top-k gate weights (Mixtral always; Qwen3-MoE's
     # norm_topk_prob flag).
     norm_topk_prob: bool = True
+    # Expert dispatch strategy: "routed" (sort-by-expert + grouped ragged
+    # matmuls — per-token expert FLOPs scale with top-k) or "dense" (masked
+    # einsum over ALL experts — the numerics oracle, and the layout that
+    # GSPMD expert-parallel sharding partitions today).
+    moe_dispatch: str = "routed"
     # Gemma-style variations: gated-GELU FFN ("gelu_tanh"), (1+w) RMSNorm
     # scaling (norm_offset=1.0), embeddings scaled by sqrt(hidden_size).
     hidden_act: str = "silu"
@@ -379,26 +384,36 @@ def _qkv(layer: Params, cfg: LlamaConfig, x: jnp.ndarray):
     return q, k, v
 
 
-def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
-    """Mixtral-style sparse-MoE SwiGLU FFN.
+def _moe_gates(layer: Params, cfg: LlamaConfig, x: jnp.ndarray):
+    """Top-k routing shared by both dispatch strategies.
 
     Gating matches HF Mixtral (`MixtralSparseMoeBlock`): softmax over ALL
-    expert logits, take top-k, renormalize the survivors. The combine is a
-    masked-dense einsum over stacked expert weights ``[E, d, f]`` — every
-    expert sees every token, with non-selected contributions zeroed by the
-    gate. That trades FLOPs for TPU-native static shapes (no gather/sort/
-    ragged dispatch XLA can't tile), and under expert-parallel sharding
-    (``E`` on the ``tp``/ep axis, `parallel/sharding.py`) each device only
-    computes its LOCAL experts for the replicated activations; the final
-    contraction over ``E`` becomes an XLA-inserted psum over ICI. With
-    E == tp (Mixtral 8x7B on a v5e-8 slice) per-device work is exactly one
-    expert per token.
+    expert logits, take top-k, renormalize the survivors. Returns
+    (top values [..., k] f32, top indices [..., k] int32).
     """
-    router_logits = (x @ layer["router"]).astype(jnp.float32)  # [b, s, E]
+    router_logits = (x @ layer["router"]).astype(jnp.float32)  # [..., E]
     weights = jax.nn.softmax(router_logits, axis=-1)
-    topv, topi = jax.lax.top_k(weights, cfg.n_experts_per_tok)  # [b, s, k]
+    topv, topi = jax.lax.top_k(weights, cfg.n_experts_per_tok)
     if cfg.norm_topk_prob:
         topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    return topv, topi
+
+
+def _moe_mlp_dense(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Masked-dense sparse-MoE SwiGLU FFN (the numerics oracle).
+
+    The combine is a masked-dense einsum over stacked expert weights
+    ``[E, d, f]`` — every expert sees every token, with non-selected
+    contributions zeroed by the gate. Exact, with TPU-native static shapes;
+    under expert-parallel sharding (``E`` on the ``tp``/ep axis,
+    `parallel/sharding.py`) each device only computes its LOCAL experts for
+    the replicated activations and the final contraction over ``E`` becomes
+    an XLA-inserted psum over ICI. With E == tp (Mixtral 8x7B on a v5e-8
+    slice) per-device work is exactly one expert per token — but at
+    E >> top-k (Qwen3-MoE's 128/8) it wastes ~E/k× expert FLOPs, which is
+    what the routed dispatch below avoids.
+    """
+    topv, topi = _moe_gates(layer, cfg, x)  # [b, s, k]
     # Scatter the renormalized top-k gates back to a dense [b, s, E] mask.
     gates = jnp.sum(
         jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32) * topv[..., None],
@@ -408,6 +423,55 @@ def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
     up = jnp.einsum("bsd,edf->ebsf", x, layer["w_up"]).astype(jnp.float32)
     act = (gate * up).astype(x.dtype)
     return jnp.einsum("ebsf,efd,bse->bsd", act, layer["w_down"], gates.astype(x.dtype))
+
+
+def _moe_mlp_routed(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Routed sparse-MoE SwiGLU FFN: grouped top-k gather dispatch.
+
+    Per-token expert FLOPs scale with ``top-k``, not ``n_experts`` — the
+    right complexity class for high-expert-count models (Qwen3-MoE 128/8:
+    16× fewer expert FLOPs than the dense oracle). TPU-native shape
+    discipline: all arrays are static-shaped in ``N*k``; the only dynamic
+    structure is the per-expert segment boundaries, which
+    ``jax.lax.ragged_dot`` consumes directly (tiled grouped matmul on MXU,
+    no padding to per-expert capacity and no dropped tokens).
+
+    Steps: flatten the (token, slot) assignments, sort them by expert id so
+    each expert's tokens form one contiguous segment, run the three FFN
+    matmuls as ragged (grouped) dots over those segments, then weight by
+    the gate values and scatter-add back per token.
+    """
+    b, s, d = x.shape
+    n = b * s
+    k = cfg.n_experts_per_tok
+    xf = x.reshape(n, d)
+    topv, topi = _moe_gates(layer, cfg, xf)  # [n, k]
+
+    expert_ids = topi.reshape(-1)  # [n*k]
+    token_ids = jnp.arange(n * k, dtype=jnp.int32) // k
+    order = jnp.argsort(expert_ids, stable=True)
+    src_tok = token_ids[order]  # [n*k] token each sorted row came from
+    xs = xf[src_tok]  # [n*k, d] gathered inputs, expert-contiguous
+    group_sizes = jnp.bincount(expert_ids, length=cfg.n_experts)
+
+    gate = cfg.act_fn(
+        jax.lax.ragged_dot(xs, layer["w_gate"], group_sizes).astype(jnp.float32)
+    )
+    up = jax.lax.ragged_dot(xs, layer["w_up"], group_sizes).astype(jnp.float32)
+    act = (gate * up).astype(x.dtype)
+    out = jax.lax.ragged_dot(act, layer["w_down"], group_sizes)  # [n*k, d]
+
+    out = out.astype(jnp.float32) * topv.reshape(-1)[order][:, None]
+    combined = jnp.zeros((n, d), jnp.float32).at[src_tok].add(out)
+    return combined.reshape(b, s, d).astype(x.dtype)
+
+
+def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.moe_dispatch == "routed":
+        return _moe_mlp_routed(layer, cfg, x)
+    if cfg.moe_dispatch == "dense":
+        return _moe_mlp_dense(layer, cfg, x)
+    raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
 
 
 def _mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:

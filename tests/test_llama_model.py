@@ -671,6 +671,124 @@ class TestMixtralMoE:
                 assert not np.allclose(got, ref)
 
 
+class TestRoutedDispatch:
+    """Grouped top-k gather dispatch vs the masked-dense oracle."""
+
+    @pytest.mark.parametrize("tiny", ["TINY_MOE", "TINY_QWEN3_MOE"])
+    @pytest.mark.parametrize("shape", [(1, 1), (2, 1), (3, 17)])
+    def test_routed_matches_dense_oracle(self, tiny, shape):
+        import dataclasses
+
+        from llm_d_kv_cache_manager_tpu.models import llama
+        from llm_d_kv_cache_manager_tpu.models.llama import _moe_mlp
+
+        cfg = getattr(llama, tiny)
+        assert cfg.moe_dispatch == "routed"  # the default under test
+        dense_cfg = dataclasses.replace(cfg, moe_dispatch="dense")
+        params = init_params(jax.random.PRNGKey(5), cfg)
+        layer = params["layers"][0]
+        rng = np.random.default_rng(11)
+        x = jnp.asarray(rng.standard_normal((*shape, cfg.hidden_size)), jnp.float32)
+        routed = np.asarray(_moe_mlp(layer, cfg, x))
+        dense = np.asarray(_moe_mlp(layer, dense_cfg, x))
+        np.testing.assert_allclose(routed, dense, rtol=1e-5, atol=1e-5)
+
+    def test_unknown_dispatch_rejected(self):
+        import dataclasses
+
+        from llm_d_kv_cache_manager_tpu.models import TINY_MOE
+        from llm_d_kv_cache_manager_tpu.models.llama import _moe_mlp
+
+        cfg = dataclasses.replace(TINY_MOE, moe_dispatch="nope")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        x = jnp.zeros((1, 2, cfg.hidden_size), jnp.float32)
+        with pytest.raises(ValueError, match="moe_dispatch"):
+            _moe_mlp(params["layers"][0], cfg, x)
+
+    def _a3b_shaped(self):
+        """Qwen3-30B-A3B expert geometry (128 experts, top-8) at reduced
+        hidden width — the E/k ratio is what's under test."""
+        import dataclasses
+
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        return dataclasses.replace(
+            llama.TINY_QWEN3_MOE,
+            hidden_size=128,
+            n_experts=128,
+            n_experts_per_tok=8,
+            moe_intermediate_size=64,
+        )
+
+    def test_routed_never_materializes_all_expert_activations(self):
+        """Structural complexity check (backend-independent): the dense
+        oracle materializes an [E, n, f] activation; the routed dispatch's
+        largest intermediate must be [n*k, f] — E/k times smaller. XLA's
+        TPU cost model confirms the FLOPs ratio (~15x at 128/8; see
+        benchmarking/bench_moe.py, which asserts it on the real chip —
+        the CPU lowering of ragged_dot is loop-dense so the ratio is not
+        measurable from a CPU compile)."""
+        import dataclasses
+
+        cfg = self._a3b_shaped()
+        from llm_d_kv_cache_manager_tpu.models.llama import _moe_mlp
+
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        layer = params["layers"][0]
+        n, k, f = 64, cfg.n_experts_per_tok, cfg.moe_inter
+        x = jnp.zeros((1, n, cfg.hidden_size), jnp.float32)
+
+        jaxpr = jax.make_jaxpr(lambda l, v: _moe_mlp(l, cfg, v))(layer, x)
+        prims = {e.primitive.name for e in jaxpr.eqns}
+        assert "ragged_dot" in prims or "ragged_dot_general" in prims, prims
+        dense_inter = cfg.n_experts * n * f
+        biggest = max(
+            int(np.prod(v.aval.shape))
+            for e in jaxpr.eqns
+            for v in e.outvars
+            if v.aval.shape
+        )
+        assert biggest < dense_inter / (cfg.n_experts / k / 2), (
+            f"routed path materializes a {biggest}-element intermediate; "
+            f"dense-oracle scale is {dense_inter}"
+        )
+
+        dense_jaxpr = jax.make_jaxpr(
+            lambda l, v: _moe_mlp(l, dataclasses.replace(cfg, moe_dispatch="dense"), v)
+        )(layer, x)
+        dense_biggest = max(
+            int(np.prod(v.aval.shape))
+            for e in dense_jaxpr.eqns
+            for v in e.outvars
+            if v.aval.shape
+        )
+        assert dense_biggest >= dense_inter  # the oracle really is dense
+
+    @pytest.mark.skipif(
+        jax.default_backend() != "tpu", reason="needs the TPU ragged_dot kernel"
+    )
+    def test_routed_flops_scale_with_top_k_not_n_experts(self):
+        """XLA TPU cost model: dense/routed FLOPs ratio ~E/k at 128/8."""
+        import dataclasses
+
+        from llm_d_kv_cache_manager_tpu.models.llama import _moe_mlp
+
+        cfg = self._a3b_shaped()
+        dense_cfg = dataclasses.replace(cfg, moe_dispatch="dense")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        layer = params["layers"][0]
+        x = jnp.zeros((1, 64, cfg.hidden_size), jnp.float32)
+
+        def flops(c):
+            fn = jax.jit(lambda l, v: _moe_mlp(l, c, v))
+            an = fn.lower(layer, x).compile().cost_analysis()
+            an = an[0] if isinstance(an, list) else an
+            return an["flops"]
+
+        ratio = flops(dense_cfg) / flops(cfg)
+        assert ratio > 8, f"dense/routed flops ratio only {ratio:.1f}"
+
+
 class TestQwen2MoeRejection:
     def test_shared_expert_moe_rejected(self):
         pytest.importorskip("torch")
