@@ -45,6 +45,7 @@ from ..ops import (
     rope_frequencies,
 )
 from ..ops.rope import RopeScalingConfig
+from .quant import QuantizedTensor, materialize as _w
 
 
 def _paged_attention_tp(
@@ -80,6 +81,39 @@ def _paged_attention_tp(
         out_specs=P(None, "tp"),
     )
     return fn(q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v)
+
+def _flash_prefill_tp(
+    q, k, v, k_pages_l, v_pages_l, block_tables, ctx_lens, n_valid, *, mesh
+):
+    """Pallas flash prefill, head-parallel over the ``tp`` mesh axis.
+
+    Same shard_map story as `_paged_attention_tp`: the kernel is a custom
+    call GSPMD cannot partition, and attention is embarrassingly parallel
+    over heads — each shard runs the kernel on its slice of query/KV heads
+    and its head-slice of the page pool; no collectives (the row-parallel
+    ``wo`` right after carries the reduction).
+    """
+    from ..ops.flash_prefill import flash_prefill_paged
+
+    if mesh is None:
+        return flash_prefill_paged(
+            q, k, v, k_pages_l, v_pages_l, block_tables, ctx_lens, n_valid
+        )
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
+        flash_prefill_paged,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp"), P(None, None, "tp"), P(None, None, "tp"),
+            P(None, None, "tp"), P(None, None, "tp"), P(), P(), P(),
+        ),
+        out_specs=P(None, None, "tp"),
+    )
+    return fn(q, k, v, k_pages_l, v_pages_l, block_tables, ctx_lens, n_valid)
+
 
 Params = dict[str, Any]
 
@@ -247,11 +281,12 @@ TINY_GEMMA = LlamaConfig(
 #: Qwen3-30B-A3B (128-expert top-8 MoE with qk-norm, decoupled 768-wide
 #: experts, renormalized gates per its checkpoint config).
 #:
-#: Caveat: the masked-dense expert einsum in ``_moe_mlp`` computes every
-#: expert per token — exact, and efficient when E ≲ tp (Mixtral 8x7B), but
-#: at E=128/top-8 it streams ~16× the routed expert weights per step. A
-#: grouped top-k gather dispatch is the planned path before this preset is
-#: production-servable at speed.
+#: Dispatch: ``moe_dispatch="routed"`` (the default) — sort-by-expert +
+#: grouped ragged matmuls, so per-token expert FLOPs scale with top-k
+#: (~E/k below the masked-dense oracle at E=128/top-8). Under an
+#: expert-parallel mesh the routed path runs inside shard_map over the
+#: expert axis (see ``parallel/sharding.py``); single-device it uses the
+#: global ``ragged_dot`` pipeline.
 QWEN3_30B_A3B = LlamaConfig(
     vocab_size=151_936,
     hidden_size=2_048,
@@ -303,16 +338,31 @@ TINY_MOE = LlamaConfig(
 )
 
 
-def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+def init_params(
+    rng: jax.Array, cfg: LlamaConfig, quantize: Optional[str] = None
+) -> Params:
     """Random-init parameter pytree (serving loads real checkpoints via
-    ``load_hf_state_dict``; training uses this directly)."""
+    ``load_hf_state_dict``; training uses this directly).
+
+    ``quantize="int8"`` quantizes each matmul weight the moment it is
+    created, so the full-precision tree is never resident — required to
+    init 8B-class models on a single chip (16 GB bf16 + 8 GB int8 would
+    not fit; see models/quant.py).
+    """
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r}")
     d, hd = cfg.hidden_size, cfg.hd
     n_q, n_kv, inter = cfg.n_heads, cfg.n_kv_heads, cfg.intermediate_size
 
-    def dense(key, shape, scale_dim):
-        return (jax.random.normal(key, shape, jnp.float32) * (scale_dim**-0.5)).astype(
+    def dense(key, shape, scale_dim, quantizable=True):
+        w = (jax.random.normal(key, shape, jnp.float32) * (scale_dim**-0.5)).astype(
             cfg.dtype
         )
+        if quantize and quantizable:
+            from .quant import quantize_tensor
+
+            return quantize_tensor(w)
+        return w
 
     # Gemma's (1+w) convention stores w≈0 for an identity norm.
     def norm_init(shape):
@@ -332,7 +382,9 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
         }
         if cfg.n_experts:
             e, f = cfg.n_experts, cfg.moe_inter
-            layer["router"] = dense(k[7], (d, e), d)
+            # Router stays full precision: tiny, and routing decisions are
+            # the most quantization-sensitive computation in an MoE.
+            layer["router"] = dense(k[7], (d, e), d, quantizable=False)
             layer["w_gate"] = dense(k[4], (e, d, f), d)
             layer["w_up"] = dense(k[5], (e, d, f), d)
             layer["w_down"] = dense(k[6], (e, f, d), f)
@@ -350,7 +402,8 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
         layers.append(layer)
 
     params: Params = {
-        "embed": dense(keys[-2], (cfg.vocab_size, d), d),
+        # Embedding stays unquantized (gather path; tighter error budget).
+        "embed": dense(keys[-2], (cfg.vocab_size, d), d, quantizable=False),
         "final_norm": norm_init((d,)),
         "layers": layers,
     }
@@ -368,9 +421,9 @@ def init_kv_pages(cfg: LlamaConfig, total_pages: int, page_size: int) -> tuple[j
 
 def _qkv(layer: Params, cfg: LlamaConfig, x: jnp.ndarray):
     b, s, d = x.shape
-    q = x @ layer["wq"]
-    k = x @ layer["wk"]
-    v = x @ layer["wv"]
+    q = x @ _w(layer["wq"], x.dtype)
+    k = x @ _w(layer["wk"], x.dtype)
+    v = x @ _w(layer["wv"], x.dtype)
     if cfg.qkv_bias:
         q = q + layer["bq"]
         k = k + layer["bk"]
@@ -419,10 +472,14 @@ def _moe_mlp_dense(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarr
         jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32) * topv[..., None],
         axis=-2,
     )
-    gate = cfg.act_fn(jnp.einsum("bsd,edf->ebsf", x, layer["w_gate"]).astype(jnp.float32))
-    up = jnp.einsum("bsd,edf->ebsf", x, layer["w_up"]).astype(jnp.float32)
+    gate = cfg.act_fn(
+        jnp.einsum("bsd,edf->ebsf", x, _w(layer["w_gate"], x.dtype)).astype(jnp.float32)
+    )
+    up = jnp.einsum("bsd,edf->ebsf", x, _w(layer["w_up"], x.dtype)).astype(jnp.float32)
     act = (gate * up).astype(x.dtype)
-    return jnp.einsum("ebsf,efd,bse->bsd", act, layer["w_down"], gates.astype(x.dtype))
+    return jnp.einsum(
+        "ebsf,efd,bse->bsd", act, _w(layer["w_down"], x.dtype), gates.astype(x.dtype)
+    )
 
 
 def _moe_mlp_routed(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -455,35 +512,147 @@ def _moe_mlp_routed(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndar
     group_sizes = jnp.bincount(expert_ids, length=cfg.n_experts)
 
     gate = cfg.act_fn(
-        jax.lax.ragged_dot(xs, layer["w_gate"], group_sizes).astype(jnp.float32)
+        jax.lax.ragged_dot(xs, _w(layer["w_gate"], x.dtype), group_sizes).astype(
+            jnp.float32
+        )
     )
-    up = jax.lax.ragged_dot(xs, layer["w_up"], group_sizes).astype(jnp.float32)
+    up = jax.lax.ragged_dot(xs, _w(layer["w_up"], x.dtype), group_sizes).astype(
+        jnp.float32
+    )
     act = (gate * up).astype(x.dtype)
-    out = jax.lax.ragged_dot(act, layer["w_down"], group_sizes)  # [n*k, d]
+    out = jax.lax.ragged_dot(act, _w(layer["w_down"], x.dtype), group_sizes)  # [n*k, d]
 
     out = out.astype(jnp.float32) * topv.reshape(-1)[order][:, None]
     combined = jnp.zeros((n, d), jnp.float32).at[src_tok].add(out)
     return combined.reshape(b, s, d).astype(x.dtype)
 
 
-def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+def _moe_mlp_routed_ep(
+    layer: Params, cfg: LlamaConfig, x: jnp.ndarray, mesh
+) -> jnp.ndarray:
+    """Expert-parallel routed dispatch under ``shard_map`` over the tp axis.
+
+    GSPMD cannot partition ``ragged_dot``'s group dimension, so the global
+    routed pipeline under a mesh would silently all-gather the full
+    ``[E, d, f]`` expert stacks — the exact HBM blow-up expert parallelism
+    exists to avoid. Here each shard holds ``E/tp`` whole experts
+    (matching ``parallel/sharding.py``'s ``P('tp', None, None)`` layout)
+    and runs the sort + ragged-dot pipeline over its LOCAL experts only;
+    the per-token combine is a psum over ICI.
+
+    Static-shape trick: every shard processes all ``n*k`` (token, slot)
+    rows — rows routed to remote experts have their expert id clamped into
+    the local range and their gate weight zeroed, so their (wasted) FFN
+    output cancels exactly in the combine. That keeps shapes static with
+    no capacity factor and NO dropped tokens. Per-shard expert FLOPs are
+    ``n*k`` rows vs dense-EP's ``n*E/tp`` rows — a win whenever
+    ``k*tp < E`` (Qwen3-MoE 128/8 at tp=8: 2x), which is the condition
+    ``_moe_mlp`` auto-selects on.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    tp = mesh.shape["tp"]
+    e_local = cfg.n_experts // tp
+    k = cfg.n_experts_per_tok
+    # Batch stays sharded over dp when the mesh has a dp axis (training);
+    # activations are replicated across tp either way.
+    batch_axis = "dp" if "dp" in mesh.shape else None
+
+    def body(router, w_gate, w_up, w_down, xs):
+        ep = jax.lax.axis_index("tp")
+        b, s, d = xs.shape
+        n = b * s
+        xf = xs.reshape(n, d)
+        # Same gating as every other dispatch (softmax over ALL experts —
+        # the router is replicated), then keep only this shard's experts.
+        topv, topi = _moe_gates({"router": router}, cfg, xf)
+        lo = ep * e_local
+        local = (topi >= lo) & (topi < lo + e_local)  # [n, k]
+        gate_w = jnp.where(local, topv, 0.0)
+        local_expert = jnp.clip(topi - lo, 0, e_local - 1)
+
+        expert_ids = local_expert.reshape(-1)  # [n*k]
+        token_ids = jnp.arange(n * k, dtype=jnp.int32) // k
+        order = jnp.argsort(expert_ids, stable=True)
+        src_tok = token_ids[order]
+        xg = xf[src_tok]  # [n*k, d] expert-contiguous
+        group_sizes = jnp.bincount(expert_ids, length=e_local)
+
+        # _w: dequantize int8 expert shards locally (specs are pytree
+        # prefixes, so a QuantizedTensor's q and scale both shard on E).
+        gate = cfg.act_fn(
+            jax.lax.ragged_dot(xg, _w(w_gate, xs.dtype), group_sizes).astype(
+                jnp.float32
+            )
+        )
+        up = jax.lax.ragged_dot(xg, _w(w_up, xs.dtype), group_sizes).astype(
+            jnp.float32
+        )
+        act = (gate * up).astype(xs.dtype)
+        out = jax.lax.ragged_dot(act, _w(w_down, xs.dtype), group_sizes)  # [n*k, d]
+
+        out = out.astype(jnp.float32) * gate_w.reshape(-1)[order][:, None]
+        combined = jnp.zeros((n, d), jnp.float32).at[src_tok].add(out)
+        combined = jax.lax.psum(combined, "tp")
+        return combined.reshape(b, s, d).astype(xs.dtype)
+
+    fn = shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P("tp", None, None),
+            P("tp", None, None),
+            P("tp", None, None),
+            P(batch_axis),
+        ),
+        out_specs=P(batch_axis),
+    )
+    return fn(layer["router"], layer["w_gate"], layer["w_up"], layer["w_down"], x)
+
+
+def _moe_mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray, mesh=None) -> jnp.ndarray:
+    if cfg.moe_dispatch not in ("routed", "dense"):
+        raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp > 1:
+        if cfg.n_experts % tp == 0:
+            # Expert-parallel mesh (weights laid out P('tp', None, None)).
+            # Routed-EP computes n*k rows per shard vs dense-EP's n*E/tp —
+            # auto-select whichever does less per-shard work; both exact.
+            if (
+                cfg.moe_dispatch == "routed"
+                and cfg.n_experts_per_tok * tp < cfg.n_experts
+            ):
+                return _moe_mlp_routed_ep(layer, cfg, x, mesh)
+            return _moe_mlp_dense(layer, cfg, x)
+        # E % tp != 0: weights use the Megatron intermediate-dim fallback
+        # (sharding.py). The global routed path would make GSPMD all-gather
+        # the full expert stacks, so ALWAYS use the dense einsum here —
+        # GSPMD partitions it along the f dimension.
+        return _moe_mlp_dense(layer, cfg, x)
     if cfg.moe_dispatch == "routed":
         return _moe_mlp_routed(layer, cfg, x)
-    if cfg.moe_dispatch == "dense":
-        return _moe_mlp_dense(layer, cfg, x)
-    raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
+    return _moe_mlp_dense(layer, cfg, x)
 
 
-def _mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(layer: Params, cfg: LlamaConfig, x: jnp.ndarray, mesh=None) -> jnp.ndarray:
     if cfg.n_experts:
-        return _moe_mlp(layer, cfg, x)
-    gate = cfg.act_fn((x @ layer["w_gate"]).astype(jnp.float32))
-    up = (x @ layer["w_up"]).astype(jnp.float32)
-    return ((gate * up).astype(x.dtype)) @ layer["w_down"]
+        return _moe_mlp(layer, cfg, x, mesh=mesh)
+    gate = cfg.act_fn((x @ _w(layer["w_gate"], x.dtype)).astype(jnp.float32))
+    up = (x @ _w(layer["w_up"], x.dtype)).astype(jnp.float32)
+    return ((gate * up).astype(x.dtype)) @ _w(layer["w_down"], x.dtype)
 
 
 def _embed(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
-    h = params["embed"][tokens]
+    emb = params["embed"]
+    if isinstance(emb, QuantizedTensor):
+        # Gather int8 rows, then scale — never materializes the bf16 table.
+        h = emb.q[tokens].astype(cfg.dtype) * emb.scale[0].astype(cfg.dtype)
+    else:
+        h = emb[tokens]
     if cfg.scale_embeddings:  # Gemma: normalizer folded out of the table
         h = h * jnp.asarray(cfg.hidden_size**0.5, h.dtype)
     return h
@@ -491,7 +660,11 @@ def _embed(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray
 
 def _logits(params: Params, cfg: LlamaConfig, h: jnp.ndarray) -> jnp.ndarray:
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_offset)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    head = (
+        _w(params["embed"], h.dtype).T
+        if cfg.tie_word_embeddings
+        else _w(params["lm_head"], h.dtype)
+    )
     return (h @ head).astype(jnp.float32)
 
 
@@ -520,7 +693,9 @@ def _scatter_kv_pages_all_layers(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg",), donate_argnames=("k_pages", "v_pages")
+    jax.jit,
+    static_argnames=("cfg", "mesh", "attn_impl"),
+    donate_argnames=("k_pages", "v_pages"),
 )
 def prefill(
     params: Params,
@@ -534,6 +709,8 @@ def prefill(
     slot_ids: jnp.ndarray,  # [b, s] destination slot per token
     block_tables: jnp.ndarray,  # [b, max_ctx_pages] int32 — cached-context pages
     ctx_lens: jnp.ndarray,  # [b] int32 — prefix-cached context length (0 = fresh)
+    mesh=None,  # tp mesh for expert-parallel MoE dispatch
+    attn_impl: str = "xla",  # "xla" (scan flash) | "pallas" (flash kernel)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Process a prompt chunk: returns (logits at last valid position per
     sequence [b, vocab], updated k_pages, v_pages).
@@ -543,8 +720,12 @@ def prefill(
     prefix-cache hit skips recomputing the shared prefix. Fresh sequences
     pass ``ctx_lens = 0``.
     """
+    if attn_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     h = _embed(params, cfg, tokens)  # [b, s, d]
+    if attn_impl == "pallas":
+        n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
 
     fresh_k = []  # per-layer [b, s, n_kv, hd] — written to pages in one go
     fresh_v = []
@@ -554,15 +735,23 @@ def prefill(
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
 
-        attn = prefill_with_paged_context(
-            q, k, v, k_pages[li], v_pages[li], block_tables, ctx_lens,
-            positions=positions, valid=valid,
-        )
+        if attn_impl == "pallas":
+            # Flash kernel (ops/flash_prefill.py). Engine contract:
+            # consecutive chunk positions, right-padded valid mask.
+            attn = _flash_prefill_tp(
+                q, k, v, k_pages[li], v_pages[li], block_tables, ctx_lens,
+                n_valid, mesh=mesh,
+            )
+        else:
+            attn = prefill_with_paged_context(
+                q, k, v, k_pages[li], v_pages[li], block_tables, ctx_lens,
+                positions=positions, valid=valid,
+            )
         b, s, _, _ = attn.shape
-        h = h + attn.reshape(b, s, -1) @ layer["wo"]
+        h = h + attn.reshape(b, s, -1) @ _w(layer["wo"], h.dtype)
 
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
-        h = h + _mlp(layer, cfg, x)
+        h = h + _mlp(layer, cfg, x, mesh=mesh)
 
         fresh_k.append(k)
         fresh_v.append(v)
@@ -634,10 +823,10 @@ def _decode_body(
             interpret=interpret,
             mesh=mesh,
         )  # [b, n_heads, hd]
-        h = h + (attn.reshape(b, -1) @ layer["wo"])[:, None, :]
+        h = h + (attn.reshape(b, -1) @ _w(layer["wo"], h.dtype))[:, None, :]
 
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
-        h = h + _mlp(layer, cfg, x)
+        h = h + _mlp(layer, cfg, x, mesh=mesh)
 
         fresh_k.append(k)
         fresh_v.append(v)

@@ -76,17 +76,46 @@ def param_specs(cfg: LlamaConfig, tp: int = 1) -> dict[str, Any]:
     return specs
 
 
-def param_shardings(mesh: Mesh, cfg: LlamaConfig):
+def param_shardings(mesh: Mesh, cfg: LlamaConfig, params: Params | None = None):
+    """NamedSharding pytree for ``params``.
+
+    When ``params`` is given and contains int8-quantized weights
+    (``models/quant.QuantizedTensor``), each one gets a matching pair of
+    shardings: the int8 payload follows the weight's spec; its scale
+    (shape ``[..., 1, out]``) follows the same spec with the contraction
+    axis (size 1 — unpartitionable) replicated.
+    """
+    specs = param_specs(cfg, tp=mesh.shape.get("tp", 1))
+    if params is None:
+        return jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    from ..models.quant import QuantizedTensor
+
+    def to_sharding(spec: P, p):
+        if isinstance(p, QuantizedTensor):
+            entries = list(spec) + [None] * (p.ndim - len(spec))
+            scale_entries = list(entries)
+            scale_entries[-2] = None
+            return QuantizedTensor(
+                q=NamedSharding(mesh, P(*entries)),
+                scale=NamedSharding(mesh, P(*scale_entries)),
+            )
+        return NamedSharding(mesh, spec)
+
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
-        param_specs(cfg, tp=mesh.shape.get("tp", 1)),
+        to_sharding,
+        specs,
+        params,
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
 def shard_params(params: Params, mesh: Mesh, cfg: LlamaConfig) -> Params:
     """Place a (host or single-device) param pytree onto the mesh."""
-    return jax.device_put(params, param_shardings(mesh, cfg))
+    return jax.device_put(params, param_shardings(mesh, cfg, params))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

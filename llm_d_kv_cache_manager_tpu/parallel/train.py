@@ -28,9 +28,13 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def _forward_logits(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+def _forward_logits(
+    params: Params, cfg: LlamaConfig, tokens: jnp.ndarray, mesh=None
+) -> jnp.ndarray:
     """Full-sequence forward for training (no KV cache): returns
-    [b, s, vocab] float32 logits."""
+    [b, s, vocab] float32 logits. ``mesh`` enables the expert-parallel
+    routed MoE dispatch (shard_map); dense layers need no mesh — GSPMD
+    partitions them from the param shardings alone."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
@@ -41,17 +45,23 @@ def _forward_logits(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jn
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         attn = causal_prefill_attention(q, k, v)
-        h = h + attn.reshape(b, s, -1) @ layer["wo"]
+        h = h + attn.reshape(b, s, -1) @ llama._w(layer["wo"], h.dtype)
         x = rms_norm(h, layer["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
-        h = h + llama._mlp(layer, cfg, x)
+        h = h + llama._mlp(layer, cfg, x, mesh=mesh)
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, cfg.norm_offset)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    head = (
+        llama._w(params["embed"], h.dtype).T
+        if cfg.tie_word_embeddings
+        else llama._w(params["lm_head"], h.dtype)
+    )
     return (h @ head).astype(jnp.float32)
 
 
-def loss_fn(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+def loss_fn(
+    params: Params, cfg: LlamaConfig, tokens: jnp.ndarray, mesh=None
+) -> jnp.ndarray:
     """Next-token cross-entropy over the sequence (mean, f32)."""
-    logits = _forward_logits(params, cfg, tokens)  # [b, s, v]
+    logits = _forward_logits(params, cfg, tokens, mesh=mesh)  # [b, s, v]
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
@@ -68,11 +78,14 @@ def make_train_state(cfg: LlamaConfig, rng: jax.Array, lr: float = 1e-4) -> Trai
     return TrainState(params=params, opt_state=opt.init(params), step=jnp.zeros((), jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "lr"), donate_argnums=(0,))
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "lr", "mesh"), donate_argnums=(0,)
+)
 def train_step(
-    state: TrainState, cfg: LlamaConfig, tokens: jnp.ndarray, lr: float = 1e-4
+    state: TrainState, cfg: LlamaConfig, tokens: jnp.ndarray, lr: float = 1e-4,
+    mesh=None,
 ) -> tuple[TrainState, jnp.ndarray]:
-    loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens)
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens, mesh=mesh)
     updates, opt_state = make_optimizer(lr).update(
         grads, state.opt_state, state.params
     )

@@ -83,6 +83,15 @@ class EngineConfig:
     #: everything else is GSPMD-partitioned by XLA. Requires
     #: n_heads % tp == 0 and n_kv_heads % tp == 0.
     tp: int = 1
+    #: prefill attention implementation: "auto" (Pallas flash kernel on
+    #: TPU, XLA scan elsewhere), "pallas", or "xla".
+    prefill_attn: str = "auto"
+    #: weight quantization: None (serve in model dtype) or "int8"
+    #: (symmetric per-output-channel weight-only int8 — halves weight HBM
+    #: bytes so 8B-class models fit one v5e chip with a KV pool;
+    #: see models/quant.py). Applied to whatever params the engine gets,
+    #: random-init or checkpoint-loaded.
+    quantize: Optional[str] = None
     seed: int = 0
 
 
@@ -115,7 +124,26 @@ class Engine:
         self.scheduler = Scheduler(self.block_manager, sched_cfg)
 
         if params is None:
-            params = llama.init_params(jax.random.PRNGKey(config.seed), cfg)
+            params = llama.init_params(
+                jax.random.PRNGKey(config.seed), cfg, quantize=config.quantize
+            )
+        elif config.quantize is not None:
+            from ..models import quant
+
+            if config.quantize != "int8":
+                raise ValueError(f"unknown quantize mode {config.quantize!r}")
+            if not quant.is_quantized(params):
+                # NB: the caller's full-precision tree stays alive during
+                # this; for models near HBM capacity init with
+                # llama.init_params(..., quantize="int8") instead.
+                params = quant.quantize_params(params)
+        if config.prefill_attn not in ("auto", "pallas", "xla"):
+            raise ValueError(f"unknown prefill_attn {config.prefill_attn!r}")
+        self.prefill_attn = config.prefill_attn
+        if self.prefill_attn == "auto":
+            self.prefill_attn = (
+                "pallas" if jax.default_backend() == "tpu" else "xla"
+            )
         self.mesh = None
         if config.tp > 1:
             if cfg.n_heads % config.tp or cfg.n_kv_heads % config.tp:
@@ -274,6 +302,8 @@ class Engine:
             jnp.asarray(slot_ids),
             jnp.asarray(ctx_bt),
             jnp.asarray(ctx_lens),
+            mesh=self.mesh,
+            attn_impl=self.prefill_attn,
         )
         first_tokens = self._sample(logits, seqs)
         now = time.monotonic()

@@ -143,6 +143,8 @@ class PodServerConfig:
         eng.decode_steps_per_iter = int(
             os.environ.get("DECODE_STEPS_PER_ITER", eng.decode_steps_per_iter)
         )
+        # Weight quantization ("int8" halves weight HBM; models/quant.py).
+        eng.quantize = os.environ.get("QUANTIZE") or None
         # CPU smoke runs (Pallas interpreter mode); never set on real TPU.
         eng.interpret = _env_bool("INTERPRET", "0")
         return cfg

@@ -1,0 +1,221 @@
+"""Pallas flash-prefill kernel (ops/flash_prefill.py) parity tests.
+
+Oracle: `prefill_with_paged_context` (the XLA scan flash). Runs the kernel
+in interpreter mode on CPU across GQA/MHA/MQA geometries, cold and warm
+context, padding, multi-block shapes, and through `llama.prefill` /
+the engine end to end. On-chip numerics are re-checked by
+benchmarking/bench_engine.py (round-1 lesson: Mosaic can miscompile what
+the interpreter gets right).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_d_kv_cache_manager_tpu.ops.attention import prefill_with_paged_context
+from llm_d_kv_cache_manager_tpu.ops.flash_prefill import flash_prefill_paged
+
+PS = 8  # page size
+
+
+def _setup(rng, b, s, n_q, n_kv, d, total_pages, max_ctx_pages, ctx_lens, n_valid):
+    q = jnp.asarray(rng.standard_normal((b, s, n_q, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, n_kv, d)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.standard_normal((total_pages, PS, n_kv, d)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.standard_normal((total_pages, PS, n_kv, d)), jnp.float32
+    )
+    # distinct pages per sequence
+    perm = rng.permutation(total_pages - 1)[: b * max_ctx_pages] + 1
+    block_tables = jnp.asarray(perm.reshape(b, max_ctx_pages), jnp.int32)
+    ctx_lens = jnp.asarray(ctx_lens, jnp.int32)
+    n_valid = jnp.asarray(n_valid, jnp.int32)
+    return q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid
+
+
+def _compare(q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid, atol=2e-5):
+    b, s = q.shape[:2]
+    positions = ctx_lens[:, None] + jnp.arange(s)[None, :]
+    valid = jnp.arange(s)[None, :] < n_valid[:, None]
+    ref = prefill_with_paged_context(
+        q, k, v, k_pages, v_pages, block_tables, ctx_lens,
+        positions=positions, valid=valid,
+    )
+    got = flash_prefill_paged(
+        q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid,
+        interpret=True,
+    )
+    # Only valid query rows are meaningful (the engine reads nothing else;
+    # the kernel zeroes them, the oracle attends context from them).
+    mask = np.asarray(valid)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * mask, np.asarray(ref) * mask, atol=atol, rtol=1e-4
+    )
+
+
+class TestFlashPrefillParity:
+    @pytest.mark.parametrize(
+        "n_q,n_kv",
+        [(8, 2), (4, 4), (8, 1)],  # GQA, MHA, MQA
+        ids=["gqa", "mha", "mqa"],
+    )
+    def test_head_geometries_with_context(self, n_q, n_kv):
+        rng = np.random.default_rng(0)
+        args = _setup(
+            rng, b=2, s=24, n_q=n_q, n_kv=n_kv, d=16, total_pages=64,
+            max_ctx_pages=4, ctx_lens=[32, 17], n_valid=[24, 24],
+        )
+        _compare(*args)
+
+    def test_cold_prefill_no_context(self):
+        rng = np.random.default_rng(1)
+        args = _setup(
+            rng, b=2, s=32, n_q=4, n_kv=2, d=16, total_pages=16,
+            max_ctx_pages=2, ctx_lens=[0, 0], n_valid=[32, 20],
+        )
+        _compare(*args)
+
+    def test_zero_max_ctx_pages_path(self):
+        """max_ctx == 0 (engine cold batch with no context table width)."""
+        rng = np.random.default_rng(2)
+        b, s, n_q, n_kv, d = 2, 16, 4, 2, 16
+        q = jnp.asarray(rng.standard_normal((b, s, n_q, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, n_kv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, n_kv, d)), jnp.float32)
+        k_pages = jnp.zeros((4, PS, n_kv, d), jnp.float32)
+        v_pages = jnp.zeros((4, PS, n_kv, d), jnp.float32)
+        block_tables = jnp.zeros((b, 0), jnp.int32)
+        ctx_lens = jnp.zeros((b,), jnp.int32)
+        n_valid = jnp.asarray([s, s - 3], jnp.int32)
+        _compare(q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid)
+
+    def test_multi_block_q_and_k(self):
+        """Sequence long enough to span several q and k blocks with tiny
+        block sizes — exercises the carry across k-steps and the causal
+        clamping of chunk block indices."""
+        rng = np.random.default_rng(3)
+        b, s, n_q, n_kv, d = 2, 64, 4, 2, 16
+        args = _setup(
+            rng, b=b, s=s, n_q=n_q, n_kv=n_kv, d=d, total_pages=64,
+            max_ctx_pages=6, ctx_lens=[48, 5], n_valid=[64, 40],
+        )
+        q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid = args
+        positions = ctx_lens[:, None] + jnp.arange(s)[None, :]
+        valid = jnp.arange(s)[None, :] < n_valid[:, None]
+        ref = prefill_with_paged_context(
+            q, k, v, k_pages, v_pages, block_tables, ctx_lens,
+            positions=positions, valid=valid,
+        )
+        got = flash_prefill_paged(
+            q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid,
+            interpret=True, q_block=16, key_block=128,
+        )
+        mask = np.asarray(valid)[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(got) * mask, np.asarray(ref) * mask, atol=2e-5, rtol=1e-4
+        )
+
+    def test_bf16_inputs(self):
+        rng = np.random.default_rng(4)
+        args = _setup(
+            rng, b=1, s=16, n_q=4, n_kv=2, d=16, total_pages=16,
+            max_ctx_pages=2, ctx_lens=[9], n_valid=[16],
+        )
+        q, k, v, k_pages, v_pages, bt, cl, nv = (
+            a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a for a in args
+        )
+        _compare(q, k, v, k_pages, v_pages, bt, cl, nv, atol=2e-2)
+
+
+class TestPrefillIntegration:
+    def test_llama_prefill_pallas_matches_xla(self):
+        """Whole-model prefill with attn_impl='pallas' vs 'xla'."""
+        from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, llama
+
+        cfg = TINY_LLAMA
+        rng = np.random.default_rng(5)
+        b, s, page = 2, 16, 4
+        total_pages = 32
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        valid = jnp.arange(s)[None, :] < jnp.asarray([[s], [s - 2]])[:, 0, None]
+        page_ids = jnp.asarray(
+            rng.permutation(total_pages - 1)[: b * (s // page)].reshape(b, -1),
+            jnp.int32,
+        ).repeat(page, axis=1)
+        slot_ids = jnp.broadcast_to(jnp.arange(s)[None, :] % page, (b, s))
+        bt = jnp.zeros((b, 2), jnp.int32)
+        cl = jnp.zeros((b,), jnp.int32)
+
+        def run(impl):
+            kp, vp = llama.init_kv_pages(cfg, total_pages, page)
+            return llama.prefill(
+                params, cfg, tokens, positions, valid, kp, vp,
+                page_ids, slot_ids, bt, cl, attn_impl=impl,
+            )
+
+        logits_x, kpx, vpx = run("xla")
+        logits_p, kpp, vpp = run("pallas")
+        np.testing.assert_allclose(
+            np.asarray(logits_p), np.asarray(logits_x), atol=1e-4, rtol=1e-4
+        )
+        # Layer>0 K/V inherit ~1e-6 noise from the differing attention
+        # summation order; the written pages must agree to that tolerance.
+        np.testing.assert_allclose(
+            np.asarray(kpp), np.asarray(kpx), atol=1e-5, rtol=1e-4
+        )
+
+    def test_engine_pallas_prefill_end_to_end(self):
+        """Engine with prefill_attn='pallas' (interpret on CPU): cold and
+        warm prefix requests complete, the warm hit fires, and the engine
+        is deterministic run-to-run. (Token-exact equality with the XLA
+        engine is NOT asserted: on a flat random-init model the two
+        implementations' ~1e-6 summation-order noise flips greedy argmax —
+        logits parity is covered at op and model level above.)"""
+        from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
+        from llm_d_kv_cache_manager_tpu.server import (
+            BlockManagerConfig,
+            Engine,
+            EngineConfig,
+            SamplingParams,
+        )
+
+        def run_once():
+            eng = Engine(
+                EngineConfig(
+                    model=TINY_LLAMA,
+                    block_manager=BlockManagerConfig(total_pages=64, page_size=4),
+                    max_model_len=64,
+                    decode_batch_size=2,
+                    prefill_bucket=8,
+                    interpret=True,
+                    prefill_attn="pallas",
+                )
+            )
+            assert eng.prefill_attn == "pallas"
+            rng = np.random.default_rng(6)
+            prompt = rng.integers(0, TINY_LLAMA.vocab_size, 18).tolist()
+            s1 = eng.add_request(prompt, SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+            s2 = eng.add_request(
+                prompt + rng.integers(0, TINY_LLAMA.vocab_size, 3).tolist(),
+                SamplingParams(max_new_tokens=3),
+            )
+            eng.run_until_complete()
+            assert len(s1.output_tokens) == 4
+            assert len(s2.output_tokens) == 3
+            assert s2.num_cached_prompt > 0
+            return s1.output_tokens, s2.output_tokens
+
+        assert run_once() == run_once()  # deterministic
+
+    def test_unknown_impl_rejected(self):
+        from llm_d_kv_cache_manager_tpu.server import Engine, EngineConfig
+
+        with pytest.raises(ValueError, match="prefill_attn"):
+            Engine(EngineConfig(prefill_attn="cuda"))
