@@ -35,7 +35,19 @@ def main() -> int:
     )
 
     on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
+    mode = os.environ.get("BENCH_MODEL", "1p4b" if on_tpu else "smoke")
+    quantize = None
+    if mode == "8b-int8":
+        # The real Llama-3-8B architecture, unscaled, weight-only int8
+        # (models/quant.py): ~8.3 GB of weights on one v5e chip, leaving
+        # room for a 2048-page KV pool (32k tokens at 128 KiB/token).
+        model_cfg = llama.LLAMA_3_8B
+        quantize = "int8"
+        prefill_len, decode_batch, max_new, n_reqs = 2048, 16, 128, 8
+        total_pages, page = 2048, 16
+        burst = 32
+        interpret = False
+    elif mode == "1p4b":
         model_cfg = LlamaConfig(
             vocab_size=32_000,
             hidden_size=3072,
@@ -69,9 +81,10 @@ def main() -> int:
         decode_steps_per_iter=burst,
         prefill_bucket=64,
         prefill_ctx_bucket=-(-max_len // page),
+        prefill_attn=os.environ.get("BENCH_PREFILL_ATTN", "auto"),
         interpret=interpret,
     )
-    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg, quantize=quantize)
     jax.block_until_ready(params)
     rng = np.random.default_rng(0)
 
@@ -104,6 +117,7 @@ def main() -> int:
                 "metric": "prefill_throughput",
                 "value": round(prefill_tps, 1),
                 "unit": "tok/s",
+                "model": mode,
                 "prefill_len": prefill_len,
                 "n_requests": n_reqs,
                 "backend": jax.default_backend(),
@@ -143,6 +157,7 @@ def main() -> int:
                 "metric": "decode_throughput",
                 "value": round(decode_tps, 1),
                 "unit": "tok/s",
+                "model": mode,
                 "decode_batch": decode_batch,
                 "decode_steps_per_iter": burst,
                 "backend": jax.default_backend(),
