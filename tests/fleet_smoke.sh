@@ -44,10 +44,26 @@ for path, doc in docs:
     kinds.setdefault(doc["kind"], []).append((path, doc))
 
 # kustomization resource refs must exist
+base_cm_keys = None
+for path, doc in docs:
+    if doc.get("kind") == "ConfigMap" and doc["metadata"]["name"] == "kv-cache-shared":
+        if "overlays" not in str(path):
+            base_cm_keys = set(doc["data"])
+assert base_cm_keys, "base kv-cache-shared ConfigMap not found"
+
 for path, doc in kinds.pop("Kustomization", []):
     for res in doc.get("resources", []):
         ref = path.parent / res
         assert ref.exists() or ref.with_suffix(".yaml").exists(), f"{path}: missing {res}"
+    # overlay patches must only touch keys the base ConfigMap declares
+    # (catches tunable-name typos that would silently not apply)
+    for patch in doc.get("patches", []):
+        # `path:`-style patches have no inline "patch" key; skip them.
+        raw = patch.get("patch") if isinstance(patch, dict) else None
+        pdoc = yaml.safe_load(raw) if raw else None
+        if pdoc and pdoc.get("kind") == "ConfigMap":
+            unknown = set(pdoc.get("data", {})) - base_cm_keys
+            assert not unknown, f"{path}: patches unknown ConfigMap keys {unknown}"
 
 # the event-plane service must target a port the scoring container exposes
 scoring = next(d for _, d in kinds["Deployment"] if d["metadata"]["name"] == "kv-cache-scoring")
@@ -90,8 +106,11 @@ if [[ "${1:-}" == "--compose" ]]; then
         SCORE=$(curl -fsS -X POST http://127.0.0.1:8080/score_completions \
             -H 'Content-Type: application/json' \
             -d "{\"prompt\": \"${PROMPT:0:64}\", \"model\": \"tiny-llama\"}" \
-            | python -c "import json,sys; print(json.load(sys.stdin)['scores'].get('tpu-pod-A', 0))" \
+            | python -c "import json,sys; print(int(json.load(sys.stdin)['scores'].get('tpu-pod-A', 0)))" \
             || echo 0)
+        # Guard: the score must be an integer before the arithmetic compare,
+        # or set -e turns a malformed response into a bash syntax error.
+        [[ "$SCORE" =~ ^[0-9]+$ ]] || SCORE=0
         [[ "$SCORE" -ge 4 ]] && break
         sleep 1
     done
