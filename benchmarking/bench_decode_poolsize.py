@@ -1,0 +1,129 @@
+"""Root-cause probe: decode throughput vs KV pool size.
+
+Round-2 finding (engine_throughput.md): ~850 tok/s at a 4096-page pool vs
+~1400 at small pools, cause unexplained. This probe separates the two
+candidate mechanisms at the MODEL level (no engine, fixed context):
+
+  time(burst) = dispatch_overhead + burst * per_step_cost
+
+For each pool size, decode_steps is timed at several fused-burst sizes and
+a line is fit. If `per_step_cost` grows with pool size, the device-side
+work scales with the pool (it should not: block tables bound what the
+kernel reads; the deferred write is one scatter). If `dispatch_overhead`
+grows, the cost is host/tunnel-side per-call bookkeeping proportional to
+donated-buffer bytes — a dev-tunnel artifact that a real TPU-VM deployment
+(~ms dispatch) would not see.
+
+Run on the chip: ``python benchmarking/bench_decode_poolsize.py``.
+One JSON line per pool size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from llm_d_kv_cache_manager_tpu.models import llama
+    from llm_d_kv_cache_manager_tpu.models.llama import LlamaConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32_000,
+            hidden_size=3072,
+            intermediate_size=8192,
+            n_layers=12,
+            n_heads=24,
+            n_kv_heads=8,
+            rope_scaling=llama.LLAMA_3_8B.rope_scaling,
+            dtype=jnp.bfloat16,
+        )
+        pool_sizes = [256, 1024, 2048, 4096]
+        bursts = [8, 32, 128]
+        batch, ctx_pages, page = 16, 16, 16  # 256-token contexts
+        reps = 5
+    else:
+        cfg = llama.TINY_LLAMA
+        pool_sizes = [64, 256]
+        bursts = [2, 8]
+        batch, ctx_pages, page = 4, 4, 4
+        reps = 2
+
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    jax.block_until_ready(params)
+    rng = np.random.default_rng(0)
+
+    for total_pages in pool_sizes:
+        # Per-sequence block tables within the pool; context fills ctx_pages.
+        bt = np.zeros((batch, ctx_pages), np.int32)
+        stride = max(total_pages // batch, ctx_pages)
+        for i in range(batch):
+            bt[i] = np.arange(ctx_pages) + (i * stride) % (total_pages - ctx_pages)
+        block_tables = jnp.asarray(bt)
+        start_len = (ctx_pages - 1) * page  # room to grow across bursts
+
+        def run_burst(n_steps, k_pages, v_pages):
+            tokens = jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (batch,)), jnp.int32
+            )
+            positions = jnp.full((batch,), start_len, jnp.int32)
+            seq_lens = jnp.full((batch,), start_len + 1, jnp.int32)
+            out = llama.decode_steps(
+                params, cfg, tokens, positions, k_pages, v_pages,
+                block_tables, seq_lens,
+                jnp.zeros((batch,), jnp.float32),  # greedy
+                jnp.zeros((batch,), jnp.int32),
+                jnp.ones((batch,), jnp.float32),
+                jax.random.PRNGKey(0),
+                page_size=page, num_steps=n_steps,
+            )
+            # Fetch (not just block): on the dev tunnel block_until_ready
+            # returns before execution completes; only a device->host read
+            # reliably fences the timed region.
+            np.asarray(out[0][:, -1])
+            return out[1], out[2]  # donated pools returned
+
+        row = {
+            "metric": "decode_poolsize",
+            "total_pages": total_pages,
+            "pool_mb": round(
+                2 * cfg.n_layers * total_pages * page * cfg.n_kv_heads * cfg.hd
+                * 2 / 1e6
+            ),
+            "batch": batch,
+            "backend": jax.default_backend(),
+        }
+        times = {}
+        for n_steps in bursts:
+            k_pages, v_pages = llama.init_kv_pages(cfg, total_pages, page)
+            k_pages, v_pages = run_burst(n_steps, k_pages, v_pages)  # compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                k_pages, v_pages = run_burst(n_steps, k_pages, v_pages)
+            times[n_steps] = (time.perf_counter() - t0) / reps * 1e3
+            del k_pages, v_pages
+        # least-squares fit: t = a + b * burst
+        xs = np.asarray(bursts, np.float64)
+        ys = np.asarray([times[n] for n in bursts], np.float64)
+        b_fit, a_fit = np.polyfit(xs, ys, 1)
+        row["call_ms_by_burst"] = {str(k): round(v, 2) for k, v in times.items()}
+        row["dispatch_overhead_ms"] = round(a_fit, 2)
+        row["per_step_ms"] = round(b_fit, 3)
+        row["tok_s_at_burst32"] = round(batch * 32 / times.get(32, times[bursts[-1]]) * 1e3, 1)
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,11 +67,17 @@ def main() -> int:
             compiled = fn.lower(layer, x).compile()
             an = compiled.cost_analysis()
             an = an[0] if isinstance(an, list) else an
-            fn(layer, x).block_until_ready()  # warm
+            np.asarray(fn(layer, x)[0, 0, :1])  # warm + fence
+            # Chain each call's output into the next input AND fence with a
+            # device->host fetch: repeated identical dispatches can be
+            # elided/overlapped by the runtime, and on the dev tunnel
+            # block_until_ready returns before execution completes
+            # (observed: "timings" 100x over hardware peak without these).
+            y = x
             t0 = time.perf_counter()
             for _ in range(reps):
-                out = fn(layer, x)
-            out.block_until_ready()
+                y = fn(layer, y)
+            np.asarray(y[0, 0, :1])
             row[name + "_ms"] = round((time.perf_counter() - t0) / reps * 1e3, 3)
             row[name + "_gflops"] = round(an.get("flops", 0) / 1e9, 3)
         row["value"] = row["routed_ms"]

@@ -49,7 +49,8 @@ from .quant import QuantizedTensor, materialize as _w
 
 
 def _paged_attention_tp(
-    q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v, *, interpret, mesh
+    q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v, *, interpret, mesh,
+    layer: int = 0
 ):
     """Decode attention, head-parallel over the ``tp`` mesh axis.
 
@@ -59,23 +60,30 @@ def _paged_attention_tp(
     parallel over heads, so no collectives are needed here (the row-parallel
     ``wo`` matmul immediately after carries the cross-shard reduction).
 
-    ``fresh_k``/``fresh_v`` ([b, n_kv, hd]) carry the current token's K/V so
-    pool writes can be deferred past attention (see ``paged_attention``).
+    ``kp``/``vp`` are the FULL multi-layer pools ``[L, P, ps, n_kv, hd]``
+    with ``layer`` resolved inside the kernel's index map — slicing the
+    layer here would force XLA to copy a whole per-layer pool per call
+    (see paged_attention's docstring). ``fresh_k``/``fresh_v``
+    ([b, n_kv, hd]) carry the current token's K/V so pool writes can be
+    deferred past attention.
     """
     if mesh is None:
         return paged_attention(
             q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v,
-            interpret=interpret,
+            interpret=interpret, layer=layer,
         )
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import shard_map_compat
 
+    kv_spec = (
+        P(None, None, None, "tp") if kp.ndim == 5 else P(None, None, "tp")
+    )
     fn = shard_map_compat(
-        functools.partial(paged_attention, interpret=interpret),
+        functools.partial(paged_attention, interpret=interpret, layer=layer),
         mesh=mesh,
         in_specs=(
-            P(None, "tp"), P(None, None, "tp"), P(None, None, "tp"), P(), P(),
+            P(None, "tp"), kv_spec, kv_spec, P(), P(),
             P(None, "tp"), P(None, "tp"),
         ),
         out_specs=P(None, "tp"),
@@ -814,14 +822,15 @@ def _decode_body(
         # rebuild (which cost 2×pool bytes of HBM traffic per token).
         attn = _paged_attention_tp(
             q[:, 0],  # [b, n_heads, hd]
-            k_pages[li],
-            v_pages[li],
+            k_pages,  # FULL [L, P, ps, n_kv, hd] pool; layer via index map
+            v_pages,
             block_tables,
             seq_lens,
             k[:, 0],  # [b, n_kv, hd]
             v[:, 0],
             interpret=interpret,
             mesh=mesh,
+            layer=li,
         )  # [b, n_heads, hd]
         h = h + (attn.reshape(b, -1) @ _w(layer["wo"], h.dtype))[:, None, :]
 

@@ -43,8 +43,8 @@ def _decode_kernel(
     seq_lens_ref,  # [batch] int32
     # blocks (fresh_*_ref present only when has_fresh)
     q_ref,  # [1, n_kv, group, head_dim]
-    k_ref,  # [1, page_size, n_kv, head_dim]
-    v_ref,  # [1, page_size, n_kv, head_dim]
+    k_ref,  # [1, 1, page_size, n_kv, head_dim] (leading layer dim)
+    v_ref,  # [1, 1, page_size, n_kv, head_dim]
     *refs,  # [fresh_k_ref, fresh_v_ref,] out_ref, m_ref, l_ref, acc_ref
     page_size: int,
     scale: float,
@@ -81,8 +81,8 @@ def _decode_kernel(
         q = q_ref[0].astype(jnp.float32)  # [n_kv, group, d]
         # Page tile arrives [page_size, n_kv, d] (one fully-contiguous
         # block); swap to head-major for the batched dot.
-        k = jnp.swapaxes(k_ref[0].astype(jnp.float32), 0, 1)  # [n_kv, ps, d]
-        v = jnp.swapaxes(v_ref[0].astype(jnp.float32), 0, 1)
+        k = jnp.swapaxes(k_ref[0, 0].astype(jnp.float32), 0, 1)  # [n_kv, ps, d]
+        v = jnp.swapaxes(v_ref[0, 0].astype(jnp.float32), 0, 1)
 
         # Batched over kv heads: [n_kv, group, page_size]
         scores = jax.lax.dot_general(
@@ -141,11 +141,11 @@ def _decode_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("page_size", "scale", "interpret"),
+    static_argnames=("page_size", "scale", "interpret", "layer"),
 )
 def paged_attention(
     q: jnp.ndarray,  # [batch, n_heads, head_dim]
-    k_pages: jnp.ndarray,  # [total_pages, page_size, n_kv_heads, head_dim]
+    k_pages: jnp.ndarray,  # [(n_layers,) total_pages, page_size, n_kv, head_dim]
     v_pages: jnp.ndarray,  # same
     block_tables: jnp.ndarray,  # [batch, max_pages] int32; pad slots with 0
     seq_lens: jnp.ndarray,  # [batch] int32
@@ -155,6 +155,7 @@ def paged_attention(
     page_size: Optional[int] = None,
     scale: Optional[float] = None,
     interpret: bool = False,
+    layer: int = 0,
 ) -> jnp.ndarray:
     """Batched single-token (decode) paged attention.
 
@@ -166,9 +167,22 @@ def paged_attention(
     arguments and the pages are treated as holding only the ``seq_len - 1``
     historical tokens — the caller may then write the pool *after*
     attention in one batched scatter (no per-layer pool rebuild).
+
+    Pools may be passed as the FULL multi-layer array
+    ``[n_layers, pages, ps, n_kv, hd]`` with ``layer`` selecting the
+    layer inside the kernel's index map. This matters: slicing
+    ``k_pages[li]`` outside would make XLA materialize a full per-layer
+    pool copy per call (custom calls cannot take slice views — measured
+    as the decode pool-size throughput cliff, benchmarking/
+    bench_decode_poolsize.py); with the 5-D operand the custom call
+    reads the carry buffer in place and DMAs only the block-table pages.
     """
     batch, n_heads, head_dim = q.shape
-    _total, ps, n_kv_heads, _hd = k_pages.shape
+    if k_pages.ndim == 4:  # single-layer callers: free bitcast, layer 0
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+        layer = 0
+    _L, _total, ps, n_kv_heads, _hd = k_pages.shape
     page_size = ps if page_size is None else page_size
     if scale is None:
         scale = head_dim**-0.5
@@ -192,15 +206,15 @@ def paged_attention(
         return (b, 0, 0, 0)
 
     def kv_index(b, p, bt, sl):
-        return (bt[b, p], 0, 0, 0)
+        return (layer, bt[b, p], 0, 0, 0)
 
     def out_index(b, p, bt, sl):
         return (b, 0, 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, n_kv_heads, group, head_dim), q_index),
-        pl.BlockSpec((1, page_size, n_kv_heads, head_dim), kv_index),
-        pl.BlockSpec((1, page_size, n_kv_heads, head_dim), kv_index),
+        pl.BlockSpec((1, 1, page_size, n_kv_heads, head_dim), kv_index),
+        pl.BlockSpec((1, 1, page_size, n_kv_heads, head_dim), kv_index),
     ]
     inputs = [block_tables, seq_lens, q_blocked, k_pages, v_pages]
     if has_fresh:
