@@ -371,6 +371,9 @@ def main() -> int:
 
     host_pages = int(os.environ.get("BENCH_HOST_PAGES", "0"))
     total_pages = int(os.environ.get("BENCH_TOTAL_PAGES", total_pages))
+    n_groups = int(os.environ.get("BENCH_GROUPS", n_groups))
+    reqs_per_group = int(os.environ.get("BENCH_REQS_PER_GROUP", reqs_per_group))
+    prefix_len = int(os.environ.get("BENCH_PREFIX_LEN", prefix_len))
     policies = tuple(
         os.environ.get("BENCH_POLICIES", ",".join(ALL_POLICIES)).split(",")
     )

@@ -41,16 +41,18 @@ def _round_up(n: int, multiple: int) -> int:
 
 
 @jax.jit
-def _read_page(pages: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """Gather one KV page [n_layers, page_size, n_kv, hd] for host offload."""
+def _read_pages_batch(pages: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Gather a batch of KV pages [n_layers, n, page_size, n_kv, hd]."""
     return jnp.take(pages, idx, axis=1)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def _write_page(pages: jnp.ndarray, idx: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
-    """Scatter one host page back into the pool — donated, so XLA updates
-    the pool in place instead of copying it."""
-    return pages.at[:, idx].set(data)
+def _write_pages_batch(
+    pages: jnp.ndarray, idx: jnp.ndarray, data: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter a batch of pages into the pool (donated; padded slots carry
+    an out-of-range index and are dropped)."""
+    return pages.at[:, idx].set(data, mode="drop")
 
 
 @dataclass
@@ -173,20 +175,92 @@ class Engine:
             self._host_k = np.zeros(slot_shape, np_dtype)
             self._host_v = np.zeros(slot_shape, np_dtype)
             self.block_manager.attach_host_pool(self._offload_page, self._restore_page)
+        self._pending_offloads: list = []
+        self._pending_restores: list = []
+        self._off_by_slot: dict = {}
+        self._restore_by_page: dict = {}
         self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self.finished: list[Sequence] = []
         self._step_count = 0
 
-    # -- host-DRAM tier movers ----------------------------------------------
+    # -- host-DRAM tier movers (batched) ------------------------------------
+    #
+    # The block manager calls the movers synchronously during scheduling,
+    # but paying a device round-trip PER PAGE makes the tier unusable under
+    # thrash (each dispatch costs ~100ms on the dev tunnel; real TPU-VMs
+    # also prefer few large DMAs to many small ones). The movers therefore
+    # only QUEUE moves; `_flush_page_moves` runs before the next device
+    # dispatch — the only point where pool contents are read or
+    # overwritten — as ONE batched gather and ONE batched scatter.
+    #
+    # Ordering hazards handled (all within a single scheduling round):
+    # - restore from a slot whose offload is still pending → source the
+    #   restore from the offloading device page, not the stale host slot;
+    # - offload of a page that has a pending restore into it (restored
+    #   then evicted again) → source the offload from the restore's data;
+    # - host snapshots are taken at queue time, so later slot reuse cannot
+    #   corrupt an already-queued restore.
     def _offload_page(self, page: int, slot: int) -> None:
-        idx = jnp.asarray(page, jnp.int32)
-        self._host_k[slot] = np.asarray(_read_page(self.k_pages, idx))
-        self._host_v[slot] = np.asarray(_read_page(self.v_pages, idx))
+        src = self._restore_by_page.get(page, ("page", page))
+        self._pending_offloads.append((slot, src))
+        self._off_by_slot[slot] = src
 
     def _restore_page(self, slot: int, page: int) -> None:
-        idx = jnp.asarray(page, jnp.int32)
-        self.k_pages = _write_page(self.k_pages, idx, jnp.asarray(self._host_k[slot]))
-        self.v_pages = _write_page(self.v_pages, idx, jnp.asarray(self._host_v[slot]))
+        src = self._off_by_slot.get(slot)
+        if src is None:
+            src = ("data", self._host_k[slot].copy(), self._host_v[slot].copy())
+        self._pending_restores.append((page, src))
+        self._restore_by_page[page] = src
+
+    def _flush_page_moves(self) -> None:
+        if not self._pending_offloads and not self._pending_restores:
+            return
+        # One batched gather for every device page any queued move reads.
+        need = []
+        for _, src in self._pending_offloads + self._pending_restores:
+            if src[0] == "page" and src[1] not in need:
+                need.append(src[1])
+        page_data = {}
+        if need:
+            # Bucket the gather width to limit compile count.
+            n = 1 << (len(need) - 1).bit_length()
+            idx = np.asarray(need + [need[0]] * (n - len(need)), np.int32)
+            k_data = np.asarray(_read_pages_batch(self.k_pages, jnp.asarray(idx)))
+            v_data = np.asarray(_read_pages_batch(self.v_pages, jnp.asarray(idx)))
+            for i, p in enumerate(need):
+                page_data[p] = (k_data[:, i], v_data[:, i])
+
+        def resolve(src):
+            return page_data[src[1]] if src[0] == "page" else (src[1], src[2])
+
+        for slot, src in self._pending_offloads:
+            self._host_k[slot], self._host_v[slot] = resolve(src)
+
+        if self._pending_restores:
+            total = self.config.block_manager.total_pages
+            # Dedupe by destination page, LAST queued restore wins: a page
+            # restored, rolled back, recycled, and restored again within
+            # one window must land the second block's data (duplicate
+            # scatter indices have no ordering guarantee in XLA).
+            by_dst = {p: src for p, src in self._pending_restores}
+            dst = list(by_dst.keys())
+            datas = [resolve(src) for src in by_dst.values()]
+            n = 1 << (len(dst) - 1).bit_length()
+            pad = n - len(dst)
+            idx = jnp.asarray(dst + [total] * pad, jnp.int32)  # pad → drop
+            k_stack = np.stack([d[0] for d in datas] + [datas[0][0]] * pad, 1)
+            v_stack = np.stack([d[1] for d in datas] + [datas[0][1]] * pad, 1)
+            self.k_pages = _write_pages_batch(
+                self.k_pages, idx, jnp.asarray(k_stack)
+            )
+            self.v_pages = _write_pages_batch(
+                self.v_pages, idx, jnp.asarray(v_stack)
+            )
+
+        self._pending_offloads.clear()
+        self._pending_restores.clear()
+        self._off_by_slot.clear()
+        self._restore_by_page.clear()
 
     # -- public API ---------------------------------------------------------
     def add_request(
@@ -290,6 +364,10 @@ class Engine:
             ctx_bt[i, :n_ctx_pages] = seq.block_table[:n_ctx_pages]
             ctx_lens[i] = start
 
+        # Flush queued page moves LAST before the dispatch (restores must
+        # land before attention reads; spilled pages must be snapshotted
+        # before this prefill overwrites them).
+        self._flush_page_moves()
         logits, self.k_pages, self.v_pages = llama.prefill(
             self.params,
             self.model_cfg,
@@ -348,6 +426,9 @@ class Engine:
             bt = seq.block_table
             block_tables[i, : len(bt)] = bt
 
+        # Flush queued page moves LAST before the dispatch: anything the
+        # dispatch will overwrite must have its spill snapshot read first.
+        self._flush_page_moves()
         logits, self.k_pages, self.v_pages = llama.decode_step(
             self.params,
             self.model_cfg,
@@ -411,6 +492,10 @@ class Engine:
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
 
+        # Flush AFTER burst reservation (which can preempt + recycle pages,
+        # queueing offloads whose content this dispatch overwrites) and
+        # immediately before the device call.
+        self._flush_page_moves()
         self._rng, key = jax.random.split(self._rng)
         toks, self.k_pages, self.v_pages = llama.decode_steps(
             self.params,

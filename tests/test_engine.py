@@ -466,6 +466,38 @@ class TestHostDramOffloadTier:
         assert outs == ref_outs
         assert s.num_cached_prompt > 0  # repeat of A hit the restored pages
 
+    def test_fused_decode_spill_snapshots_before_overwrite(self):
+        """Regression for the batched-mover ordering hazard: during FUSED
+        decode, burst reservation can preempt a victim and recycle its
+        pages; the queued offload must snapshot the victim's KV BEFORE the
+        same dispatch overwrites those pages (flush must run after
+        reservation, before decode_steps). A later repeat of the victim's
+        prompt restores from host and must match a no-eviction engine."""
+        prompts = [_prompt(80 + i, 20) for i in range(3)]
+
+        def run(total_pages, host_pages):
+            eng = _engine(
+                total_pages=total_pages,
+                host_pages=host_pages,
+                decode_batch=4,
+                decode_steps_per_iter=4,
+            )
+            outs = []
+            # Concurrent requests on a tight pool: fused-burst reservation
+            # preempts and spills mid-flight.
+            for p in prompts:
+                eng.add_request(p, SamplingParams(max_new_tokens=8))
+            eng.run_until_complete()
+            # Repeat the first prompt: served from restored host pages.
+            s = eng.add_request(prompts[0], SamplingParams(max_new_tokens=8))
+            eng.run_until_complete()
+            outs.append(s.output_tokens)
+            return outs, s
+
+        ref_outs, _ = run(total_pages=64, host_pages=0)
+        tiered_outs, s = run(total_pages=14, host_pages=64)
+        assert tiered_outs == ref_outs
+
     def test_offload_and_restore_emit_medium_tagged_events(self):
         captured = []
         eng = _engine(total_pages=12, host_pages=32, on_events=captured.extend)

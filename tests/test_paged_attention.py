@@ -40,6 +40,28 @@ class TestPagedAttentionKernel:
         out = paged_attention(q, k, v, bt, sl, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
+    def test_5d_pool_layer_index_matches_sliced_pool(self):
+        """Passing the full multi-layer pool with `layer=li` must equal
+        attention over the sliced per-layer pool — the 5-D operand is the
+        form the decode body uses so XLA never materializes a per-layer
+        pool copy around the custom call (results/decode_poolsize.md)."""
+        rng = np.random.default_rng(7)
+        L, B, NH, NKV, D, PS, MAXP = 3, 2, 4, 2, 64, 8, 3
+        NPAGES = B * MAXP + 1
+        q = jnp.array(rng.standard_normal((B, NH, D)), jnp.float32)
+        k5 = jnp.array(rng.standard_normal((L, NPAGES, PS, NKV, D)), jnp.float32)
+        v5 = jnp.array(rng.standard_normal((L, NPAGES, PS, NKV, D)), jnp.float32)
+        bt = jnp.array(
+            rng.permutation(NPAGES)[: B * MAXP].reshape(B, MAXP), jnp.int32
+        )
+        sl = jnp.array([13, 20], jnp.int32)
+        for li in range(L):
+            ref = paged_attention_reference(q, k5[li], v5[li], bt, sl)
+            out = paged_attention(q, k5, v5, bt, sl, layer=li, interpret=True)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+            )
+
     def test_zero_length_sequence_is_zero_not_nan(self):
         q, k, v, bt = _setup(1, B=2, NH=4, NKV=2, D=64, PS=8, NPAGES=6, MAXP=2)
         sl = jnp.array([0, 16], jnp.int32)
