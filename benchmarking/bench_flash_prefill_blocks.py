@@ -64,10 +64,17 @@ def main() -> int:
         np.asarray(y[0, 0, 0, :1])
         return (time.perf_counter() - t0) / reps * 1e3
 
-    xla_ms = time_fn(
-        lambda qq: prefill_with_paged_context(
-            qq, k, v, k_pages, v_pages, bt, cl, positions=positions, valid=valid
+    # jit the oracle with every array as a traced ARGUMENT (un-jitted it
+    # dispatches eagerly op-by-op; closing over the arrays would bake them
+    # in as constants and let XLA fold the q-independent gather/concat out
+    # of the timed region — asymmetric vs the Pallas path's jit).
+    xla_jit = jax.jit(
+        lambda qq, k, v, kp, vp, bt, cl, pos, val: prefill_with_paged_context(
+            qq, k, v, kp, vp, bt, cl, positions=pos, valid=val
         )
+    )
+    xla_ms = time_fn(
+        lambda qq: xla_jit(qq, k, v, k_pages, v_pages, bt, cl, positions, valid)
     )
     print(json.dumps({"impl": "xla_scan", "ms": round(xla_ms, 2)}), flush=True)
 

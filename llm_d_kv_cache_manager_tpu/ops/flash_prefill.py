@@ -48,8 +48,11 @@ from jax.experimental.pallas import tpu as pltpu
 # zeroed by the mask multiply — float('-inf') would produce inf-inf = NaN.
 _NEG_INF = -1e30
 
-#: default key-block (lane-tiled) and query-block (sublane-tiled) sizes
-KEY_BLOCK = 512
+#: default key-block (lane-tiled) and query-block (sublane-tiled) sizes.
+#: (256, 1024) won the on-chip sweep (benchmarking/
+#: bench_flash_prefill_blocks.py) by ~35% over (256, 512): fewer, larger
+#: k-steps amortize per-step overhead and keep the MXU fed.
+KEY_BLOCK = 1024
 QUERY_BLOCK = 256
 #: cap on bq*group score rows — bounds the [rows, bk] f32 score tile and
 #: the f32 scratch so high-group (MQA-ish) geometries fit in 16 MB VMEM
