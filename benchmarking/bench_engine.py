@@ -71,6 +71,7 @@ def main() -> int:
         burst = 2
         interpret = True
 
+    decode_batch = int(os.environ.get("BENCH_DECODE_BATCH", decode_batch))
     max_len = prefill_len + max_new + page
     cfg = EngineConfig(
         model=model_cfg,

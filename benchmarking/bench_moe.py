@@ -46,7 +46,13 @@ def main() -> int:
         reps = 3
 
     dense_cfg = dataclasses.replace(cfg, moe_dispatch="dense")
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    # BENCH_QUANT=int8: int8 EXPERT stacks (the opt-in path — the default
+    # skips experts because this very benchmark showed the dequant doesn't
+    # fuse into ragged_dot; results/moe_dispatch.md).
+    quant = os.environ.get("BENCH_QUANT") or None
+    params = init_params(
+        jax.random.PRNGKey(0), cfg, quantize=quant, quantize_experts=bool(quant)
+    )
     layer = params["layers"][0]
     rng = np.random.default_rng(0)
 
@@ -60,6 +66,7 @@ def main() -> int:
             "tokens": b * s,
             "n_experts": cfg.n_experts,
             "top_k": cfg.n_experts_per_tok,
+            "quantize": quant,
             "backend": jax.default_backend(),
         }
         for name, c in (("routed", cfg), ("dense", dense_cfg)):

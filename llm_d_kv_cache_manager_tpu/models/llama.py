@@ -347,7 +347,10 @@ TINY_MOE = LlamaConfig(
 
 
 def init_params(
-    rng: jax.Array, cfg: LlamaConfig, quantize: Optional[str] = None
+    rng: jax.Array,
+    cfg: LlamaConfig,
+    quantize: Optional[str] = None,
+    quantize_experts: bool = False,
 ) -> Params:
     """Random-init parameter pytree (serving loads real checkpoints via
     ``load_hf_state_dict``; training uses this directly).
@@ -355,7 +358,10 @@ def init_params(
     ``quantize="int8"`` quantizes each matmul weight the moment it is
     created, so the full-precision tree is never resident — required to
     init 8B-class models on a single chip (16 GB bf16 + 8 GB int8 would
-    not fit; see models/quant.py).
+    not fit; see models/quant.py). MoE expert stacks stay in model dtype
+    unless ``quantize_experts=True`` (int8 experts measured slower — the
+    dequant doesn't fuse into ragged_dot, results/moe_dispatch.md — so
+    opt in only where HBM capacity forces it).
     """
     if quantize not in (None, "int8"):
         raise ValueError(f"unknown quantize mode {quantize!r}")
@@ -393,9 +399,9 @@ def init_params(
             # Router stays full precision: tiny, and routing decisions are
             # the most quantization-sensitive computation in an MoE.
             layer["router"] = dense(k[7], (d, e), d, quantizable=False)
-            layer["w_gate"] = dense(k[4], (e, d, f), d)
-            layer["w_up"] = dense(k[5], (e, d, f), d)
-            layer["w_down"] = dense(k[6], (e, f, d), f)
+            layer["w_gate"] = dense(k[4], (e, d, f), d, quantizable=quantize_experts)
+            layer["w_up"] = dense(k[5], (e, d, f), d, quantizable=quantize_experts)
+            layer["w_down"] = dense(k[6], (e, f, d), f, quantizable=quantize_experts)
         else:
             layer["w_gate"] = dense(k[4], (d, inter), d)
             layer["w_up"] = dense(k[5], (d, inter), d)

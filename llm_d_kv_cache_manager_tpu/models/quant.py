@@ -86,17 +86,29 @@ def materialize(p: Any, dtype: Any) -> jnp.ndarray:
 
 
 def quantize_params(
-    params: Any, *, quantize_embed: bool = False
+    params: Any, *, quantize_embed: bool = False, quantize_experts: bool = False
 ) -> Any:
     """Return the param tree with every eligible matmul weight replaced
-    by a :class:`QuantizedTensor`. Leaves everything else untouched."""
+    by a :class:`QuantizedTensor`. Leaves everything else untouched.
+
+    MoE expert stacks (3-D ``[E, in, out]`` weights) are SKIPPED by
+    default: measured on-chip, int8 experts lose — XLA fuses the dequant
+    into plain dots but not into ``ragged_dot``'s group-streamed operand,
+    so the full bf16 expert stack materializes per call (routed decode
+    2.5× slower; benchmarking/results/moe_dispatch.md). Opt in with
+    ``quantize_experts=True`` only where HBM capacity forces it.
+    """
 
     def convert(d: dict) -> dict:
         out = {}
         for name, v in d.items():
             if name == "layers":
                 out[name] = [convert(layer) for layer in v]
-            elif name in QUANTIZABLE or (name == "embed" and quantize_embed):
+            elif name in QUANTIZABLE and (
+                getattr(v, "ndim", 2) == 2 or quantize_experts
+            ):
+                out[name] = quantize_tensor(v)
+            elif name == "embed" and quantize_embed:
                 out[name] = quantize_tensor(v)
             else:
                 out[name] = v

@@ -94,6 +94,10 @@ class EngineConfig:
     #: see models/quant.py). Applied to whatever params the engine gets,
     #: random-init or checkpoint-loaded.
     quantize: Optional[str] = None
+    #: also quantize MoE expert stacks. Off by default: measured SLOWER
+    #: (dequant doesn't fuse into ragged_dot, results/moe_dispatch.md);
+    #: opt in only where HBM capacity forces it.
+    quantize_experts: bool = False
     seed: int = 0
 
 
@@ -127,7 +131,10 @@ class Engine:
 
         if params is None:
             params = llama.init_params(
-                jax.random.PRNGKey(config.seed), cfg, quantize=config.quantize
+                jax.random.PRNGKey(config.seed),
+                cfg,
+                quantize=config.quantize,
+                quantize_experts=config.quantize_experts,
             )
         elif config.quantize is not None:
             from ..models import quant
@@ -138,7 +145,9 @@ class Engine:
                 # NB: the caller's full-precision tree stays alive during
                 # this; for models near HBM capacity init with
                 # llama.init_params(..., quantize="int8") instead.
-                params = quant.quantize_params(params)
+                params = quant.quantize_params(
+                    params, quantize_experts=config.quantize_experts
+                )
         if config.prefill_attn not in ("auto", "pallas", "xla"):
             raise ValueError(f"unknown prefill_attn {config.prefill_attn!r}")
         self.prefill_attn = config.prefill_attn
