@@ -335,15 +335,31 @@ def main() -> int:
 
     on_tpu = jax.default_backend() == "tpu"
     smoke = os.environ.get("BENCH_SMOKE") == "1" or not on_tpu
+    quantize = None
+    bench_model = os.environ.get("BENCH_MODEL", "1p4b")
+    assert bench_model in ("1p4b", "8b-int8"), bench_model
 
     if smoke:
+        model_label = "tiny"
         model_cfg = llama.TINY_LLAMA
         n_pods, n_groups, reqs_per_group = 2, 4, 3
         prefix_len, suffix_len, max_new = 64, 16, 4
         total_pages, page = 256, 16
         decode_burst = 2
         interpret = not on_tpu
+    elif bench_model == "8b-int8":
+        model_label = bench_model
+        # North-star scale: the REAL Llama-3-8B architecture, int8 weights
+        # (one shared copy, ~8.3 GB) + 2 pods' KV pools on one chip.
+        model_cfg = llama.LLAMA_3_8B
+        quantize = "int8"
+        n_pods, n_groups, reqs_per_group = 2, 8, 5
+        prefix_len, suffix_len, max_new = 2048, 48, 16
+        total_pages, page = 1024, 16
+        decode_burst = 8
+        interpret = False
     else:
+        model_label = bench_model  # "1p4b"
         # Llama-3-8B-family architecture scaled (1.4B) so a 4-pod fleet
         # (one weight copy + 4 KV pools) fits one v5e chip while cold
         # prefills stay compute-bound — the analogue of the reference's
@@ -399,7 +415,7 @@ def main() -> int:
         interpret=interpret,
     )
 
-    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg, quantize=quantize)
     jax.block_until_ready(params)
 
     warmup(params, engine_cfg, prefix_len, suffix_len, model_cfg.vocab_size, max_new)
@@ -458,6 +474,8 @@ def main() -> int:
     detail = {
         "backend": jax.default_backend(),
         "smoke": smoke,
+        "model": model_label,  # the config branch actually taken
+        "quantize": quantize,
         "n_pods": n_pods,
         "n_groups": n_groups,
         "n_requests": len(workload),
