@@ -72,6 +72,11 @@ def main() -> int:
         interpret = True
 
     decode_batch = int(os.environ.get("BENCH_DECODE_BATCH", decode_batch))
+    # BENCH_QUANTIZE=int8: weight-only int8 for ANY mode (decode is
+    # weights-bandwidth-bound, so halving weight bytes is the decode lever).
+    quantize = os.environ.get("BENCH_QUANTIZE", quantize) or None
+    if quantize:
+        mode = f"{mode}+int8" if not mode.endswith("int8") else mode
     max_len = prefill_len + max_new + page
     cfg = EngineConfig(
         model=model_cfg,
