@@ -75,8 +75,13 @@ def main() -> int:
     # BENCH_QUANTIZE=int8: weight-only int8 for ANY mode (decode is
     # weights-bandwidth-bound, so halving weight bytes is the decode lever).
     quantize = os.environ.get("BENCH_QUANTIZE", quantize) or None
-    if quantize:
-        mode = f"{mode}+int8" if not mode.endswith("int8") else mode
+    if mode == "8b-int8" and quantize is None:
+        raise SystemExit(
+            "8b-int8 requires int8 weights: bf16 8B weights + the KV pool "
+            "exceed a 16 GB chip (unset BENCH_QUANTIZE or drop the override)"
+        )
+    if quantize and not mode.endswith("int8"):
+        mode = f"{mode}+int8"  # label tracks the weights actually served
     max_len = prefill_len + max_new + page
     cfg = EngineConfig(
         model=model_cfg,
