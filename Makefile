@@ -4,8 +4,9 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test unit-test e2e-test demo bench bench-smoke routing-bench \
-        engine-bench dryrun docker lint
+.PHONY: all native test unit-test e2e-test demo bench bench-smoke bench-8b \
+        routing-bench engine-bench engine-bench-8b moe-bench poolsize-bench \
+        kernel-parity dryrun docker lint
 
 all: native test
 
@@ -37,11 +38,29 @@ bench:
 bench-smoke:
 	BENCH_SMOKE=1 $(PY) bench.py
 
+## 8B-at-north-star-scale variant (real Llama-3-8B, int8, 2-pod fleet).
+bench-8b:
+	BENCH_MODEL=8b-int8 BENCH_POLICIES=round_robin,precise $(PY) bench.py
+
 routing-bench:
 	$(PY) benchmarking/bench_routing.py
 
 engine-bench:
 	$(PY) benchmarking/bench_engine.py
+
+engine-bench-8b:
+	BENCH_MODEL=8b-int8 $(PY) benchmarking/bench_engine.py
+
+moe-bench:
+	$(PY) benchmarking/bench_moe.py
+
+poolsize-bench:
+	$(PY) benchmarking/bench_decode_poolsize.py
+
+## On-chip numerics check for the Pallas flash-prefill kernel (run before
+## trusting kernel benchmarks — interpret-mode parity is not enough).
+kernel-parity:
+	$(PY) benchmarking/tpu_parity_flash_prefill.py
 
 ## Multi-chip dry-run on a virtual 8-device CPU mesh.
 dryrun:

@@ -338,6 +338,11 @@ def main() -> int:
     quantize = None
     bench_model = os.environ.get("BENCH_MODEL", "1p4b")
     assert bench_model in ("1p4b", "8b-int8"), bench_model
+    if bench_model == "8b-int8" and smoke:
+        raise SystemExit(
+            "BENCH_MODEL=8b-int8 needs the TPU backend (smoke/CPU would "
+            "silently run the tiny config under the 8B label)"
+        )
 
     if smoke:
         model_label = "tiny"

@@ -38,6 +38,8 @@ def main() -> int:
     mode = os.environ.get("BENCH_MODEL", "1p4b" if on_tpu else "smoke")
     quantize = None
     if mode == "8b-int8":
+        if not on_tpu:
+            raise SystemExit("BENCH_MODEL=8b-int8 needs the TPU backend")
         # The real Llama-3-8B architecture, unscaled, weight-only int8
         # (models/quant.py): ~8.3 GB of weights on one v5e chip, leaving
         # room for a 2048-page KV pool (32k tokens at 128 KiB/token).
