@@ -531,6 +531,69 @@ class TestHostDramOffloadTier:
         assert removed_host  # LRU host eviction happened
         assert eng.block_manager.num_host_cached_pages <= 4
 
+    def test_flush_dedupes_same_destination_page_last_wins(self, monkeypatch):
+        """Two queued restores into the same device page within one flush
+        window must land the LAST block's data, AND the batched scatter
+        must never see duplicate destination indices (duplicate-index
+        scatter order is only nondeterministic on TPU — CPU CI applies
+        last-wins regardless, so the data assertion alone could not catch
+        a dedupe regression)."""
+        from llm_d_kv_cache_manager_tpu.server import engine as engine_mod
+
+        eng = _engine(total_pages=8, host_pages=4)
+        L, ps, kv, hd = (
+            eng.model_cfg.n_layers,
+            eng.page_size,
+            eng.model_cfg.n_kv_heads,
+            eng.model_cfg.hd,
+        )
+        # Distinct K and V payloads: a K/V channel swap must not pass.
+        ak = np.full((L, ps, kv, hd), 1.0, np.float32)
+        av = np.full((L, ps, kv, hd), -1.0, np.float32)
+        bk = np.full((L, ps, kv, hd), 2.0, np.float32)
+        bv = np.full((L, ps, kv, hd), -2.0, np.float32)
+        eng._host_k[0], eng._host_v[0] = ak, av
+        eng._host_k[1], eng._host_v[1] = bk, bv
+
+        seen_idx = []
+        real_write = engine_mod._write_pages_batch
+
+        def spy(pages, idx, data):
+            seen_idx.append(np.asarray(idx))
+            return real_write(pages, idx, data)
+
+        monkeypatch.setattr(engine_mod, "_write_pages_batch", spy)
+        page = 3
+        eng._restore_page(0, page)  # A → p (later rolled back)
+        eng._restore_page(1, page)  # B → p (the live restore)
+        eng._flush_page_moves()
+        np.testing.assert_array_equal(np.asarray(eng.k_pages[:, page]), bk)
+        np.testing.assert_array_equal(np.asarray(eng.v_pages[:, page]), bv)
+        assert not eng._pending_restores and not eng._restore_by_page
+        total = eng.config.block_manager.total_pages
+        for idx in seen_idx:  # real (non-pad) destinations are unique
+            real = idx[idx < total]
+            assert len(real) == len(set(real.tolist())), idx
+
+    def test_flush_restore_from_pending_offload_slot(self):
+        """A restore sourced from a host slot whose offload is still
+        pending must read the offloading device page, not the stale host
+        slot contents — for BOTH the K and V channels."""
+        eng = _engine(total_pages=8, host_pages=2)
+        L = eng.model_cfg.n_layers
+        shape = (L, eng.page_size, eng.model_cfg.n_kv_heads, eng.model_cfg.hd)
+        mk = np.full(shape, 7.0, np.float32)
+        mv = np.full(shape, -7.0, np.float32)
+        eng.k_pages = eng.k_pages.at[:, 5].set(mk)
+        eng.v_pages = eng.v_pages.at[:, 5].set(mv)
+        eng._offload_page(5, slot=0)  # queued, host slot 0 still stale
+        eng._restore_page(0, page=2)  # restore of that very slot
+        eng._flush_page_moves()
+        np.testing.assert_array_equal(np.asarray(eng.k_pages[:, 2]), mk)
+        np.testing.assert_array_equal(np.asarray(eng.v_pages[:, 2]), mv)
+        np.testing.assert_array_equal(eng._host_k[0], mk)
+        np.testing.assert_array_equal(eng._host_v[0], mv)
+
     def test_single_host_slot_mid_restore_does_not_crash(self):
         # Regression: with host_pages=1, restoring the only host slot while
         # HBM recycling wants to spill must skip the spill, not KeyError.

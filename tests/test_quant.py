@@ -204,6 +204,38 @@ class TestQuantizedEngine:
 
 @pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 virtual devices")
 class TestQuantizedSharding:
+    def test_quantized_moe_with_expert_parallel_dispatch(self):
+        """int8 attention weights + bf16 experts under an expert-parallel
+        mesh: the QuantizedTensor sharding and the shard_map routed-EP
+        dispatch must compose (forward == unsharded)."""
+        from llm_d_kv_cache_manager_tpu.parallel import (
+            MeshConfig,
+            batch_sharding,
+            make_mesh,
+            shard_params,
+        )
+        from llm_d_kv_cache_manager_tpu.parallel.train import _forward_logits
+
+        cfg = dataclasses.replace(
+            TINY_QWEN3_MOE, n_experts=16, n_experts_per_tok=2
+        )
+        params = quantize_params(init_params(jax.random.PRNGKey(7), cfg))
+        assert isinstance(params["layers"][0]["wq"], QuantizedTensor)
+        assert not isinstance(params["layers"][0]["w_gate"], QuantizedTensor)
+        rng = np.random.default_rng(17)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+        ref = np.asarray(_forward_logits(params, cfg, tokens))
+
+        mesh = make_mesh(MeshConfig(dp=2, tp=4))  # k*tp = 8 < 16 → routed-EP
+        sharded = shard_params(params, mesh, cfg)
+        out = np.asarray(
+            jax.jit(_forward_logits, static_argnames=("cfg", "mesh"))(
+                sharded, cfg, jax.device_put(tokens, batch_sharding(mesh)),
+                mesh=mesh,
+            )
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
     def test_sharded_quantized_forward_matches_unsharded(self):
         from llm_d_kv_cache_manager_tpu.parallel import (
             MeshConfig,
