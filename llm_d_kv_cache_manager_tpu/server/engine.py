@@ -85,6 +85,14 @@ class EngineConfig:
     #: everything else is GSPMD-partitioned by XLA. Requires
     #: n_heads % tp == 0 and n_kv_heads % tp == 0.
     tp: int = 1
+    #: pipeline fused decode bursts: dispatch burst N+1 (input tokens
+    #: chained on-device from burst N's last sampled token) BEFORE
+    #: fetching/committing burst N, hiding per-iteration host work
+    #: (dispatch, fetch, commit bookkeeping) under device execution.
+    #: Needs decode_steps_per_iter > 1. Commit bookkeeping lags one burst;
+    #: any lane-set change (prefill scheduled, preemption, finish) drains
+    #: first, so results are identical to the unpipelined engine.
+    decode_pipeline: bool = False
     #: prefill attention implementation: "auto" (Pallas flash kernel on
     #: TPU, XLA scan elsewhere), "pallas", or "xla".
     prefill_attn: str = "auto"
@@ -113,11 +121,18 @@ class Engine:
         self.model_cfg = cfg
         ps = config.block_manager.page_size
         self.page_size = ps
+        self._pipeline = config.decode_pipeline and config.decode_steps_per_iter > 1
         # Width includes fused-burst headroom: a sequence finishing at
         # max_model_len mid-burst keeps writing its surplus KV into reserved
         # pages of its own row, never into another sequence's pages.
+        # Pipelining keeps up to TWO bursts in flight.
+        bursts_in_flight = 2 if self._pipeline else 1
         self.max_pages_per_seq = -(
-            -(config.max_model_len + max(config.decode_steps_per_iter - 1, 0)) // ps
+            -(
+                config.max_model_len
+                + max(config.decode_steps_per_iter * bursts_in_flight - 1, 0)
+            )
+            // ps
         )
 
         self.block_manager = BlockManager(config.block_manager, on_events=on_events)
@@ -191,6 +206,10 @@ class Engine:
         self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self.finished: list[Sequence] = []
         self._step_count = 0
+        #: in-flight fused decode burst (decode_pipeline): toks device
+        #: array, lane-ordered active list, and the np position/len arrays
+        #: the NEXT burst derives from.
+        self._inflight: Optional[dict] = None
 
     # -- host-DRAM tier movers (batched) ------------------------------------
     #
@@ -306,9 +325,14 @@ class Engine:
         """One engine iteration. Returns sequences finished this step."""
         out = self.scheduler.schedule()
         if out.prefill:
+            # Prefill must see committed decode state (page accounting,
+            # finish detection) — never overlaps an in-flight burst.
+            self._drain_inflight()
             self._run_prefill(out.prefill)
         elif out.decode:
             self._run_decode(out.decode)
+        else:
+            self._drain_inflight()
 
         newly_finished = []
         for seq in list(self.scheduler.running):
@@ -469,21 +493,56 @@ class Engine:
         host sync), then commit sampled tokens per sequence, truncating at
         stop conditions. Surplus device-side KV writes land in pages the
         sequence owns (or reserved page 0 for padded lanes) and are never
-        registered in the prefix cache, so discarding them is safe."""
+        registered in the prefix cache, so discarding them is safe.
+
+        With ``decode_pipeline``, burst N+1 is dispatched BEFORE burst N
+        is fetched: its input tokens are chained on-device from burst N's
+        last sampled token, so host work (fetch, commit, next dispatch)
+        overlaps device execution. The pipeline only continues while the
+        lane set is unchanged and no lane is about to finish; anything
+        else drains first, making results identical to the unpipelined
+        engine (a finished/preempted lane's surplus burst is discarded by
+        the same rules as surplus tokens within a burst)."""
         k = self.config.decode_steps_per_iter
         lanes = self.config.decode_batch_size
         assert len(seqs) <= lanes
 
-        # Reserve capacity for k tokens of growth per sequence; preemption
-        # inside reservation may knock later batchmates out of `seqs`.
+        prev = self._inflight
+        if prev is not None:
+            # Drain when the pipeline cannot (or should not) continue:
+            # different lane set, or every lane reaches its token budget
+            # within the in-flight burst (pipelining then only produces a
+            # surplus burst that gets discarded).
+            same_lanes = len(prev["active"]) == len(seqs) and all(
+                a is b for a, b in zip(prev["active"], seqs)
+            )
+            all_done_after_prev = all(
+                s.num_generated + k >= s.sampling.max_new_tokens for s in seqs
+            )
+            if not same_lanes or all_done_after_prev:
+                self._drain_inflight()
+                prev = None
+
+        # Reserve capacity for the burst's growth per sequence (× 2 when a
+        # previous burst is still in flight); preemption inside reservation
+        # may knock batchmates out of `seqs` — or the in-flight set.
+        reserve = k * (2 if self._pipeline else 1)
         for seq in seqs:
             if seq.block_table:
-                self._reserve_slots_or_preempt(seq, k)
+                self._reserve_slots_or_preempt(seq, reserve)
         active = [s for s in seqs if s.block_table]
+        if prev is not None:
+            same = len(prev["active"]) == len(active) and all(
+                a is b for a, b in zip(prev["active"], active)
+            )
+            if not same:  # reservation preempted an in-flight lane
+                self._drain_inflight()
+                prev = None
+                active = [s for s in active if not self._should_finish(s)]
         if not active:
+            self._drain_inflight()
             return
 
-        tokens = np.zeros((lanes,), np.int32)
         positions = np.zeros((lanes,), np.int32)
         seq_lens = np.zeros((lanes,), np.int32)  # 0 = inactive lane
         block_tables = np.zeros((lanes, self._decode_table_width(active)), np.int32)
@@ -492,14 +551,25 @@ class Engine:
         top_p = np.ones((lanes,), np.float32)
 
         for i, seq in enumerate(active):
-            tokens[i] = seq.all_tokens[-1]
-            positions[i] = seq.num_tokens - 1
-            seq_lens[i] = seq.num_tokens
             bt = seq.block_table
             block_tables[i, : len(bt)] = bt
             temperature[i] = seq.sampling.temperature
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
+
+        if prev is not None:
+            # Chain from the in-flight burst: last sampled token stays on
+            # device; positions/lengths advance by k without a host sync.
+            tokens_dev = prev["toks"][:, -1]
+            positions = prev["positions"] + k
+            seq_lens = prev["seq_lens"] + k
+        else:
+            tokens = np.zeros((lanes,), np.int32)
+            for i, seq in enumerate(active):
+                tokens[i] = seq.all_tokens[-1]
+                positions[i] = seq.num_tokens - 1
+                seq_lens[i] = seq.num_tokens
+            tokens_dev = jnp.asarray(tokens)
 
         # Flush AFTER burst reservation (which can preempt + recycle pages,
         # queueing offloads whose content this dispatch overwrites) and
@@ -509,7 +579,7 @@ class Engine:
         toks, self.k_pages, self.v_pages = llama.decode_steps(
             self.params,
             self.model_cfg,
-            jnp.asarray(tokens),
+            tokens_dev,
             jnp.asarray(positions),
             self.k_pages,
             self.v_pages,
@@ -524,9 +594,34 @@ class Engine:
             interpret=self.config.interpret,
             mesh=self.mesh,
         )
-        toks = np.asarray(toks)  # [lanes, k] — the one host sync
-        for i, seq in enumerate(active):
-            for j in range(k):
+        burst = {
+            "toks": toks,
+            "active": active,
+            "k": k,
+            "positions": np.asarray(positions),
+            "seq_lens": np.asarray(seq_lens),
+        }
+        if prev is not None:
+            # Commit burst N while burst N+1 executes on device.
+            self._inflight = None
+            self._commit_burst(prev)
+        if self._pipeline:
+            self._inflight = burst
+        else:
+            self._commit_burst(burst)
+
+    def _drain_inflight(self) -> None:
+        if self._inflight is None:
+            return
+        burst, self._inflight = self._inflight, None
+        self._commit_burst(burst)
+
+    def _commit_burst(self, burst: dict) -> None:
+        toks = np.asarray(burst["toks"])  # [lanes, k] — the one host sync
+        for i, seq in enumerate(burst["active"]):
+            if not seq.block_table:
+                continue  # preempted after this burst was dispatched
+            for j in range(burst["k"]):
                 # Pre-check keeps the num_generated <= max_new_tokens
                 # invariant even when a reservation abort clamped the cap
                 # before the burst ran.
