@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Mint `bert_prompt_ids.json` — bert-base-uncased ids for the golden prompt.
+
+Needs network (or a populated HF cache). Run from the repo root:
+
+    python tests/golden/mint_bert_ids.py
+
+Contract (must match the reference's tokenize path,
+`pkg/tokenization/tokenizer.go:110-123`): fast (Rust) tokenizer,
+special tokens ADDED (`EncodeWithOptions(input, true, ...)`), no
+truncation, no padding.
+"""
+
+import json
+import pathlib
+
+HERE = pathlib.Path(__file__).parent
+MODEL = "bert-base-uncased"
+
+
+def main() -> None:
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(MODEL, use_fast=True)
+    prompt = (HERE / "bert_prompt.txt").read_text(encoding="utf-8")
+    ids = tok.encode(prompt, add_special_tokens=True, truncation=False)
+    out = {
+        "model": MODEL,
+        "add_special_tokens": True,
+        "prompt_sha256": __import__("hashlib").sha256(prompt.encode()).hexdigest(),
+        "ids": ids,
+    }
+    (HERE / "bert_prompt_ids.json").write_text(json.dumps(out))
+    print(f"wrote {len(ids)} ids")
+
+
+if __name__ == "__main__":
+    main()
