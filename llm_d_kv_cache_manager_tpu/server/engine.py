@@ -28,7 +28,7 @@ from ..kvcache.kvevents.events import Event
 from ..models import llama
 from ..models.llama import LlamaConfig
 from ..utils import get_logger
-from .block_manager import BlockManager, BlockManagerConfig
+from .block_manager import AllocationError, BlockManager, BlockManagerConfig
 from ..ops.sampling import sample_tokens
 from .scheduler import Scheduler, SchedulerConfig
 from .sequence import SamplingParams, Sequence, SequenceStatus
@@ -91,7 +91,10 @@ class EngineConfig:
     #: (dispatch, fetch, commit bookkeeping) under device execution.
     #: Needs decode_steps_per_iter > 1. Commit bookkeeping lags one burst;
     #: any lane-set change (prefill scheduled, preemption, finish) drains
-    #: first, so results are identical to the unpipelined engine.
+    #: first, so greedy results are bit-identical to the unpipelined
+    #: engine. (temperature>0 streams are identically DISTRIBUTED but not
+    #: bit-identical across the two modes: discarded surplus bursts
+    #: consume extra splits of the engine rng.)
     decode_pipeline: bool = False
     #: prefill attention implementation: "auto" (Pallas flash kernel on
     #: TPU, XLA scan elsewhere), "pallas", or "xla".
@@ -500,9 +503,12 @@ class Engine:
         last sampled token, so host work (fetch, commit, next dispatch)
         overlaps device execution. The pipeline only continues while the
         lane set is unchanged and no lane is about to finish; anything
-        else drains first, making results identical to the unpipelined
-        engine (a finished/preempted lane's surplus burst is discarded by
-        the same rules as surplus tokens within a burst)."""
+        else drains first, making greedy results identical to the
+        unpipelined engine (a finished/preempted lane's surplus burst is
+        discarded by the same rules as surplus tokens within a burst).
+        temperature>0 streams are identically distributed but not
+        bit-identical across modes — discarded surplus bursts consume
+        extra engine-rng splits."""
         k = self.config.decode_steps_per_iter
         lanes = self.config.decode_batch_size
         assert len(seqs) <= lanes
@@ -523,14 +529,47 @@ class Engine:
                 self._drain_inflight()
                 prev = None
 
+        # Commit lag means any drain can finish lanes mid-call; never
+        # reserve pages for (or redispatch) a finished sequence — the
+        # unpipelined engine would have finished it a step() ago.
+        seqs = [s for s in seqs if not self._should_finish(s)]
+        if not seqs:
+            return
+
         # Reserve capacity for the burst's growth per sequence (× 2 when a
         # previous burst is still in flight); preemption inside reservation
         # may knock batchmates out of `seqs` — or the in-flight set.
         reserve = k * (2 if self._pipeline else 1)
         for seq in seqs:
-            if seq.block_table:
-                self._reserve_slots_or_preempt(seq, reserve)
-        active = [s for s in seqs if s.block_table]
+            # The finished re-check matters after a mid-loop degrade-drain
+            # (below): committing the lagged burst can finish any lane, and
+            # reserving (worse: preempting a batchmate, or aborting) for a
+            # sequence that already completed is the unpipelined engine's
+            # never-happens case.
+            if not seq.block_table or self._should_finish(seq):
+                continue
+            if reserve > k:
+                # Double-burst headroom is an optimization, not a
+                # requirement: when the pool is too tight for it, drain and
+                # degrade to the unpipelined reservation rather than
+                # preempting/aborting lanes the unpipelined engine would
+                # complete. (Preemption stays reserved for genuine
+                # single-burst pressure below, keeping behavior identical
+                # to decode_pipeline=False under the same pool.)
+                try:
+                    self.block_manager.reserve_slots(seq, reserve)
+                    continue
+                except AllocationError:
+                    self._drain_inflight()
+                    prev = None
+                    reserve = k
+                    if self._should_finish(seq):
+                        continue  # the drain just finished this lane
+            self._reserve_slots_or_preempt(seq, reserve)
+        # A degrade-drain above may also have finished lanes.
+        active = [
+            s for s in seqs if s.block_table and not self._should_finish(s)
+        ]
         if prev is not None:
             same = len(prev["active"]) == len(active) and all(
                 a is b for a, b in zip(prev["active"], active)
@@ -560,9 +599,13 @@ class Engine:
         if prev is not None:
             # Chain from the in-flight burst: last sampled token stays on
             # device; positions/lengths advance by k without a host sync.
+            # Inactive padded lanes keep their 0 = inactive sentinel — they
+            # must not run garbage attention or write KV into reserved
+            # page 0 just because the active lanes advanced.
             tokens_dev = prev["toks"][:, -1]
-            positions = prev["positions"] + k
-            seq_lens = prev["seq_lens"] + k
+            was_active = prev["seq_lens"] > 0
+            positions = np.where(was_active, prev["positions"] + k, 0)
+            seq_lens = np.where(was_active, prev["seq_lens"] + k, 0)
         else:
             tokens = np.zeros((lanes,), np.int32)
             for i, seq in enumerate(active):

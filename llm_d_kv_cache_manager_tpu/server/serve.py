@@ -143,6 +143,9 @@ class PodServerConfig:
         eng.decode_steps_per_iter = int(
             os.environ.get("DECODE_STEPS_PER_ITER", eng.decode_steps_per_iter)
         )
+        # Pipeline fused-decode bursts (host/device overlap); needs
+        # DECODE_STEPS_PER_ITER > 1 to take effect.
+        eng.decode_pipeline = _env_bool("DECODE_PIPELINE", "0")
         # Weight quantization ("int8" halves weight HBM; models/quant.py).
         eng.quantize = os.environ.get("QUANTIZE") or None
         # CPU smoke runs (Pallas interpreter mode); never set on real TPU.

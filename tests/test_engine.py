@@ -392,6 +392,156 @@ class TestFusedDecode:
             assert len(s.output_tokens) == 8
 
 
+class TestDecodePipeline:
+    """decode_pipeline=True: burst N+1 dispatched before burst N commits.
+
+    Invariant under test (engine.py ``_run_decode_fused`` docstring): the
+    pipelined token streams are IDENTICAL to the unpipelined fused engine
+    across every drain edge — staggered arrivals (lane-set change),
+    preemption inside reservation, stop tokens, and max-token truncation
+    that is not a multiple of the burst.
+    """
+
+    def _outputs(self, drive, **kw):
+        outs = []
+        for pipelined in (False, True):
+            eng = _engine(
+                decode_steps_per_iter=4, decode_pipeline=pipelined, **kw
+            )
+            outs.append(drive(eng))
+        return outs
+
+    def test_pipelined_greedy_matches_unpipelined(self):
+        prompts = [_prompt(20 + i, 9 + i) for i in range(3)]
+
+        def drive(eng):
+            seqs = [
+                eng.add_request(p, SamplingParams(max_new_tokens=13))
+                for p in prompts
+            ]
+            eng.run_until_complete()
+            return [s.generated_tokens for s in seqs]
+
+        base, piped = self._outputs(drive)
+        assert base == piped
+        # 13 % 4 != 0: the final partial burst (and any surplus pipelined
+        # burst) must be truncated identically.
+        assert all(len(toks) == 13 for toks in base)
+
+    def test_staggered_arrival_lane_change_drains(self):
+        # A second request arriving mid-generation forces a prefill (and
+        # thus a pipeline drain + lane-set change) between decode bursts.
+        def drive(eng):
+            a = eng.add_request(_prompt(30, 8), SamplingParams(max_new_tokens=12))
+            for _ in range(3):
+                eng.step()
+            b = eng.add_request(_prompt(31, 10), SamplingParams(max_new_tokens=12))
+            eng.run_until_complete()
+            return [a.generated_tokens, b.generated_tokens]
+
+        base, piped = self._outputs(drive)
+        assert base == piped
+        assert all(len(toks) == 12 for toks in base)
+
+    def test_pipelined_preemption_tiny_pool(self):
+        # Pool sized to force preemption during burst reservation — the
+        # in-flight burst's lane may be knocked out, and the 2x pipelined
+        # headroom must degrade to the unpipelined reservation instead of
+        # aborting lanes the unpipelined engine completes.
+        from llm_d_kv_cache_manager_tpu.server.block_manager import AllocationError
+
+        def drive(eng):
+            bm = eng.block_manager
+            orig = bm.reserve_slots
+            pressure = [0]
+
+            def spy(seq, n):
+                try:
+                    return orig(seq, n)
+                except AllocationError:
+                    pressure[0] += 1
+                    raise
+
+            bm.reserve_slots = spy
+            seqs = [
+                eng.add_request(_prompt(10 + i, 8), SamplingParams(max_new_tokens=8))
+                for i in range(3)
+            ]
+            eng.run_until_complete()
+            assert pressure[0] > 0, "pool never under pressure; test too big"
+            assert all(s.error is None for s in seqs)
+            return [s.generated_tokens for s in seqs]
+
+        base, piped = self._outputs(drive, total_pages=12, decode_batch=3)
+        assert base == piped
+        assert all(len(toks) == 8 for toks in base)
+
+    def test_pipelined_stop_token_truncates(self):
+        probe_eng = _engine(decode_steps_per_iter=4)
+        probe = probe_eng.add_request(_prompt(2, 8), SamplingParams(max_new_tokens=3))
+        probe_eng.run_until_complete()
+        stop = probe.output_tokens[1]
+
+        def drive(eng):
+            seq = eng.add_request(
+                _prompt(2, 8),
+                SamplingParams(max_new_tokens=8, stop_token_ids=(stop,)),
+            )
+            eng.run_until_complete()
+            return seq.generated_tokens
+
+        base, piped = self._outputs(drive)
+        assert base == piped
+        assert piped[-1] == stop and len(piped) == 2
+
+    def test_pipelined_prefix_cache_still_consistent(self):
+        # Pages registered while a burst is in flight must only cover
+        # committed tokens; a same-prefix follow-up must reproduce tokens.
+        p = _prompt(3, 16)
+
+        def drive(eng):
+            a = eng.add_request(p, SamplingParams(max_new_tokens=6))
+            eng.run_until_complete()
+            b = eng.add_request(p, SamplingParams(max_new_tokens=6))
+            eng.run_until_complete()
+            assert b.num_cached_prompt > 0
+            return [a.generated_tokens, b.generated_tokens]
+
+        base, piped = self._outputs(drive)
+        assert base == piped
+
+    def test_inactive_lane_sentinel_preserved_when_chaining(self):
+        # White-box: when burst N+1 chains on-device from burst N, only
+        # previously-active lanes advance; padded lanes keep the
+        # documented 0 = inactive sentinel (no garbage attention, no KV
+        # writes into reserved page 0).
+        eng = _engine(decode_batch=4, decode_steps_per_iter=2, decode_pipeline=True)
+        seqs = [
+            eng.add_request(_prompt(40 + i, 8), SamplingParams(max_new_tokens=20))
+            for i in range(2)
+        ]
+        eng.step()  # prefills both (max_prefill_batch=4)
+        eng._run_decode_fused(seqs)  # burst 1 in flight
+        assert eng._inflight is not None
+        eng._run_decode_fused(seqs)  # burst 2 chained from burst 1
+        burst = eng._inflight
+        np.testing.assert_array_equal(burst["seq_lens"][2:], 0)
+        np.testing.assert_array_equal(burst["positions"][2:], 0)
+        assert (burst["seq_lens"][:2] > 0).all()
+        eng._drain_inflight()
+
+    def test_env_knob_wires_decode_pipeline(self, monkeypatch):
+        from llm_d_kv_cache_manager_tpu.server.serve import PodServerConfig
+
+        monkeypatch.setenv("DECODE_PIPELINE", "1")
+        monkeypatch.setenv("DECODE_STEPS_PER_ITER", "4")
+        cfg = PodServerConfig.from_env()
+        assert cfg.engine.decode_pipeline is True
+        assert cfg.engine.decode_steps_per_iter == 4
+        monkeypatch.setenv("DECODE_PIPELINE", "0")
+        assert PodServerConfig.from_env().engine.decode_pipeline is False
+
+
 class TestTensorParallelServing:
     """EngineConfig.tp > 1: Megatron-sharded params + head-parallel KV over
     a tp mesh (CPU-virtualized devices; conftest forces 8)."""
