@@ -27,10 +27,10 @@ Method — virtual-clock fleet co-simulation on one real chip:
 - Policies (the reference's four, `37-capacity/README.md`):
   * `round_robin` — the reference's "random"/default-k8s analogue
   * `load`        — least outstanding requests
-  * `estimated`   — prefix-affinity WITHOUT the index: remembers which pod
-    each token-block chain was routed to (TokenProcessor chunk hashes, the
-    same component the indexer uses) but never sees KV events, so it
-    cannot know about evictions or actual cache state
+  * `estimated`   — prefix-affinity WITHOUT the index: models each pod's
+    cache as a capacity-bounded LRU of routed token-block chains (with
+    optional TTL decay) but never sees KV events, so it cannot know about
+    real evictions, preemptions or actual cache state
   * `precise`     — KV-cache index scores (this project)
 
 Prints ONE JSON line:
@@ -46,6 +46,11 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
   BENCH_HOST_PAGES=N   host-DRAM offload tier slots per pod (tier evidence)
   BENCH_TOTAL_PAGES=N  override per-pod HBM page-pool size
   BENCH_QPS_SCALES=x,y,z  override the ramp multipliers
+  BENCH_EVENT_LAG_MS=N publish→index event visibility lag (default 2 ms —
+                       the ms-scale ZMQ+decode hop of a real deployment;
+                       0 restores the drain-everything optimistic co-sim)
+  BENCH_EST_TTL_S=N    estimated-router affinity TTL (default off; the
+                       capacity-LRU is the binding bound in these runs)
 """
 
 from __future__ import annotations
@@ -91,14 +96,59 @@ def build_workload(
     return out
 
 
+class LaggedEventBus:
+    """Models the publish→index latency of a real deployment: an event
+    batch a pod publishes at virtual time T becomes visible to the indexer
+    at T + lag (the ZMQ hop + pool decode the reference's deployments eat,
+    `37-capacity/README.md` numbers include it). lag=0 reproduces the
+    optimistic drain-everything co-sim. Stable sort on (visible_at, stage
+    order) preserves per-pod FIFO — every pod has the same lag and
+    monotonically increasing stamps."""
+
+    def __init__(self, pool, lag_s: float):
+        self.pool = pool
+        self.lag_s = lag_s
+        self._staged: list[tuple[float, object]] = []
+
+    def stage(self, msg, published_at: float) -> None:
+        self._staged.append((published_at + self.lag_s, msg))
+
+    def release(self, now: float) -> None:
+        """Deliver every staged message visible by ``now`` and drain the
+        ingestion pool, so a routing decision at ``now`` sees exactly the
+        events a real indexer would have by then."""
+        keep = []
+        send = []
+        for item in self._staged:
+            (send if item[0] <= now else keep).append(item)
+        if send:
+            send.sort(key=lambda item: item[0])
+            for _, msg in send:
+                self.pool.add_task(msg)
+            self.pool.drain(timeout=10.0)
+        self._staged = keep
+
+    def flush_all(self) -> None:
+        self.release(float("inf"))
+
+
 class Pod:
     """One simulated serving replica: a real engine + a virtual clock."""
 
-    def __init__(self, pod_id, engine_cfg, params, publish):
+    def __init__(self, pod_id, engine_cfg, params, publish, bus):
         from llm_d_kv_cache_manager_tpu.server.engine import Engine
 
         self.pod_id = pod_id
-        self.engine = Engine(engine_cfg, params=params, on_events=publish(pod_id))
+        make_msg = publish(pod_id)
+        self.bus = bus
+        self._unstamped: list[object] = []
+        # Stage the message; step_timed stamps it with the post-step clock
+        # (events are flushed at the end of engine.step()).
+        self.engine = Engine(
+            engine_cfg,
+            params=params,
+            on_events=lambda events: self._unstamped.append(make_msg(events)),
+        )
         self.clock = 0.0
         self.seqs = []  # every sequence routed here
         self.hit_stats: dict[int, tuple[int, int]] = {}  # first-prefill hits
@@ -113,6 +163,10 @@ class Pod:
         t0 = time.perf_counter()
         done = self.engine.step()
         self.clock += time.perf_counter() - t0
+        if self._unstamped:
+            for msg in self._unstamped:
+                self.bus.stage(msg, self.clock)
+            self._unstamped.clear()
         # Record first-token virtual times (running lanes catch prefill
         # first-tokens; `done` catches sequences that finished this step).
         sched = self.engine.scheduler
@@ -157,51 +211,70 @@ def make_event_pipeline(index, n_pods):
     def publish(pod_id):
         pod_name = f"tpu-pod-{pod_id}"
 
-        def on_events(events):
+        def make_msg(events):
             batch = EventBatch(ts=0.0, events=list(events))
-            pool.add_task(
-                Message(
-                    topic=f"kv@{pod_name}@{MODEL_NAME}",
-                    pod_identifier=pod_name,
-                    model_name=MODEL_NAME,
-                    payload=batch.to_payload(),
-                )
+            return Message(
+                topic=f"kv@{pod_name}@{MODEL_NAME}",
+                pod_identifier=pod_name,
+                model_name=MODEL_NAME,
+                payload=batch.to_payload(),
             )
 
-        return on_events
+        return make_msg
 
     return pool, publish
 
 
 class EstimatedRouter:
     """Prefix-affinity scorer WITHOUT the KV index (the reference's
-    "default"/estimated comparator): remembers which pod each token-block
-    chain hash was routed to, using the same TokenProcessor chunking the
-    real indexer uses — but it never sees KV events, so it is blind to
-    evictions and actual pool state."""
+    "estimated" comparator — the llm-d scheduler's index-free prefix
+    scorer, which models each server's cache instead of observing it):
+    remembers which pod each token-block chain hash was routed to, using
+    the same TokenProcessor chunking the real indexer uses. Per pod the
+    memory is a capacity-bounded LRU (capacity = the pod's actual page
+    pool, in blocks) with optional TTL decay, so the model approximates
+    the pod's own LRU eviction rather than remembering forever — the
+    strongest index-free baseline. It still never sees KV events: real
+    evictions, preemptions and cross-policy cache state stay invisible,
+    which is precisely the gap `precise` closes."""
 
-    def __init__(self, page_size, n_pods):
+    def __init__(self, page_size, n_pods, capacity_blocks, ttl_s=None):
+        from collections import OrderedDict
+
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
             ChunkedTokenDatabase,
             TokenProcessorConfig,
         )
 
         self.tp = ChunkedTokenDatabase(TokenProcessorConfig(block_size=page_size))
-        self.routed: list[set[int]] = [set() for _ in range(n_pods)]
+        self.capacity = capacity_blocks
+        self.ttl_s = ttl_s
+        #: per-pod OrderedDict: block hash -> last-touch virtual time
+        self.routed = [OrderedDict() for _ in range(n_pods)]
 
     def keys(self, tokens):
         return self.tp.prefix_hashes(tokens)
 
-    def score(self, keys, pod):
+    def score(self, keys, pod, now):
+        lru = self.routed[pod]
         n = 0
         for h in keys:
-            if h not in self.routed[pod]:
+            ts = lru.get(h)
+            if ts is None or (self.ttl_s is not None and now - ts > self.ttl_s):
                 break
             n += 1
         return n
 
-    def record(self, keys, pod):
-        self.routed[pod].update(keys)
+    def record(self, keys, pod, now):
+        """Refresh the routed chain in the pod's modeled LRU (insertion
+        order = recency), then evict past capacity — mirroring what the
+        pod's own page pool will do with the blocks this request touches."""
+        lru = self.routed[pod]
+        for h in keys:
+            lru.pop(h, None)
+            lru[h] = now
+        while len(lru) > self.capacity:
+            lru.popitem(last=False)
 
 
 def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
@@ -219,9 +292,24 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
         KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=page))
     )
     pool, publish = make_event_pipeline(indexer.kv_block_index, n_pods)
-    pods = [Pod(i, engine_cfg, params, publish) for i in range(n_pods)]
+    lag_s = float(os.environ.get("BENCH_EVENT_LAG_MS", "2")) / 1000.0
+    bus = LaggedEventBus(pool, lag_s)
+    pods = [Pod(i, engine_cfg, params, publish, bus) for i in range(n_pods)]
     pod_names = [f"tpu-pod-{i}" for i in range(n_pods)]
-    est = EstimatedRouter(page, n_pods) if policy == "estimated" else None
+    est = None
+    if policy == "estimated":
+        ttl_env = os.environ.get("BENCH_EST_TTL_S", "")
+        # Modeled capacity covers everything the pod can serve hits from:
+        # HBM pages plus the host-DRAM tier when enabled (otherwise the
+        # estimated baseline would be handicapped in exactly the
+        # BENCH_HOST_PAGES tier-evidence runs).
+        est = EstimatedRouter(
+            page,
+            n_pods,
+            capacity_blocks=engine_cfg.block_manager.total_pages
+            + engine_cfg.block_manager.host_pages,
+            ttl_s=float(ttl_env) if ttl_env else None,
+        )
 
     ttfts: dict[int, float] = {}
     arrivals: dict[int, float] = {}
@@ -233,7 +321,9 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
         for pod in pods:
             pod.advance_to(t, ttfts, arrivals)
         if policy == "precise":
-            pool.drain(timeout=10.0)
+            # The index sees exactly the events a real deployment's
+            # indexer would have by the arrival instant (publish + lag).
+            bus.release(t)
             scores = indexer.score_tokens(tokens, MODEL_NAME, pod_names)
             best = max(
                 range(n_pods),
@@ -243,9 +333,9 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
             keys = est.keys(tokens)
             best = max(
                 range(n_pods),
-                key=lambda i: (est.score(keys, i), -pods[i].load, -i),
+                key=lambda i: (est.score(keys, i, t), -pods[i].load, -i),
             )
-            est.record(keys, best)
+            est.record(keys, best, t)
         elif policy == "load":
             best = min(range(n_pods), key=lambda i: (pods[i].load, i))
         else:  # round_robin
@@ -262,6 +352,7 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
         segments[seq.seq_id] = seg
     for pod in pods:
         pod.drain(ttfts, arrivals)
+    bus.flush_all()
     pool.drain(timeout=10.0)
     pool.shutdown()
     indexer.shutdown()
@@ -281,6 +372,15 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     prompt_tokens = sum(n for p in pods for _, n in p.hit_stats.values())
     cached_tokens = sum(c for p in pods for c, _ in p.hit_stats.values())
     out_tokens = sum(len(s.output_tokens) for p in pods for s in p.seqs)
+    # The Pod.on_events closure references the Pod (staging buffer), so
+    # Pod <-> Engine is now a reference CYCLE: without an explicit collect,
+    # each policy's engines (~GBs of donated KV pools on the chip) survive
+    # into the next policy until the cycle collector happens to run — which
+    # OOMs the second policy on a 16 GB chip.
+    import gc
+
+    pods.clear()
+    gc.collect()
     return {
         "p50_ttft_s": float(np.median(all_ttfts)),
         "p90_ttft_s": float(np.percentile(all_ttfts, 90)),
@@ -487,6 +587,7 @@ def main() -> int:
         "prefix_len": prefix_len,
         "host_pages": host_pages,
         "total_pages": total_pages,
+        "event_lag_ms": float(os.environ.get("BENCH_EVENT_LAG_MS", "2")),
         "qps_ramp": [round(q, 2) for q in qps_ramp],
         "results": results,
     }
