@@ -1,0 +1,89 @@
+"""deploy/ tunables-surface validation (the values.yaml analogue).
+
+The reference parameterizes a deployment in one reviewed file
+(`vllm-setup-helm/values.yaml:6,46` — hash seed, TP, replicas, model);
+ours is `deploy/values.env` (+ one per overlay), turned into the shared
+`kv-cache-shared` ConfigMap by kustomize. These tests pin the contract:
+
+- every values.env declares the hash-parity pair (BLOCK_SIZE,
+  PYTHONHASHSEED) — the reference's documented footgun is misaligning
+  them between engine and indexer (token_processor.go:37-40);
+- overlay values.env files only use keys the base declares (typo guard);
+- every declared key is actually consumed by the server processes'
+  env-reading code, so the surface can't drift into dead tunables.
+"""
+
+import pathlib
+import re
+
+import yaml
+
+REPO = pathlib.Path(__file__).parent.parent
+DEPLOY = REPO / "deploy"
+SERVER_SRC = REPO / "llm_d_kv_cache_manager_tpu" / "server"
+
+PARITY = {"BLOCK_SIZE", "PYTHONHASHSEED", "MODEL_NAME"}
+
+
+def _env_keys(p: pathlib.Path) -> dict:
+    out = {}
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#") and "=" in line:
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _all_values_envs():
+    return sorted(DEPLOY.rglob("values.env"))
+
+
+def test_base_values_env_exists_with_parity_pair():
+    keys = set(_env_keys(DEPLOY / "values.env"))
+    assert PARITY <= keys
+
+
+def test_every_overlay_ships_a_full_values_env():
+    base = set(_env_keys(DEPLOY / "values.env"))
+    overlays = [p for p in _all_values_envs() if "overlays" in str(p)]
+    assert overlays, "no overlay values.env found"
+    for p in overlays:
+        keys = set(_env_keys(p))
+        assert PARITY <= keys, f"{p}: missing parity keys {PARITY - keys}"
+        # Exact equality, not subset: `behavior: replace` drops every key
+        # the overlay omits (no merge with the base), and serve.py would
+        # silently fall back to code defaults for the missing tunable.
+        assert keys == base, (
+            f"{p}: unknown keys {keys - base or '{}'}; "
+            f"missing keys {base - keys or '{}'}"
+        )
+
+
+def test_kustomizations_generate_the_shared_map_from_values_env():
+    gens = 0
+    for kpath in sorted(DEPLOY.rglob("kustomization.yaml")):
+        doc = yaml.safe_load(kpath.read_text())
+        for gen in doc.get("configMapGenerator", []):
+            if gen.get("name") != "kv-cache-shared":
+                continue
+            gens += 1
+            # envFrom consumers need the stable (unhashed) name.
+            assert gen.get("options", {}).get("disableNameSuffixHash")
+            for env_ref in gen.get("envs", []):
+                assert (kpath.parent / env_ref).exists()
+            if "overlays" in str(kpath):
+                assert gen.get("behavior") == "replace"
+    assert gens >= 3  # base + both overlays
+
+
+def test_declared_keys_are_consumed_by_server_env_readers():
+    src = "".join(
+        p.read_text() for p in SERVER_SRC.glob("*.py")
+    )
+    consumed = set(re.findall(r'os\.environ(?:\.get)?\(\s*"([A-Z_]+)"', src))
+    consumed |= set(re.findall(r'_env_bool\(\s*"([A-Z_]+)"', src))
+    consumed |= set(re.findall(r'"([A-Z_]+)" in os\.environ', src))
+    for p in _all_values_envs():
+        dead = set(_env_keys(p)) - consumed
+        assert not dead, f"{p}: keys nothing consumes: {dead}"
