@@ -43,27 +43,38 @@ def load_params(
     path: str,
     cfg: Optional[LlamaConfig] = None,
     mesh=None,
+    quantize: Optional[str] = None,
 ) -> Params:
     """Restore a parameter pytree.
 
-    With ``cfg`` + ``mesh`` the restore targets the Megatron partition specs
-    from ``parallel/sharding.py``: every array lands on-device already
-    sharded (no host round-trip through a replicated copy).
+    With ``cfg`` the restore targets the exact pytree structure of
+    ``init_params`` — including ``QuantizedTensor`` container nodes when the
+    checkpoint was saved from ``quantize="int8"`` params (pass the same
+    ``quantize`` here; a structureless restore would flatten the containers
+    into plain dicts and the engine would refuse the tree).
+
+    With ``cfg`` + ``mesh`` the restore additionally targets the Megatron
+    partition specs from ``parallel/sharding.py``: every array lands
+    on-device already sharded (no host round-trip through a replicated
+    copy); int8 payloads follow their weight's spec, scales replicate the
+    contraction axis.
     """
     ckptr = _checkpointer()
     path = os.path.abspath(path)
-    if cfg is None or mesh is None:
+    if cfg is None:
         return ckptr.restore(path)
-    # Abstract arrays carrying the target shardings: orbax reads each shard
-    # straight into its device placement. Shapes/dtypes come from tracing
-    # init_params (no compute), keeping this independent of orbax's
-    # metadata API shape.
+    # Abstract arrays carrying the target structure (and shardings, when a
+    # mesh is given): orbax reads each shard straight into its device
+    # placement. Shapes/dtypes come from tracing init_params (no compute),
+    # keeping this independent of orbax's metadata API shape.
     from ..models.llama import init_params
 
-    shardings = param_shardings(mesh, cfg)
     abstract_params = jax.eval_shape(
-        lambda: init_params(jax.random.PRNGKey(0), cfg)
+        lambda: init_params(jax.random.PRNGKey(0), cfg, quantize=quantize)
     )
+    if mesh is None:
+        return ckptr.restore(path, abstract_params)
+    shardings = param_shardings(mesh, cfg, abstract_params)
     abstract = jax.tree.map(
         lambda m, s: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=s),
         abstract_params,
