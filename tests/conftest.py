@@ -37,3 +37,11 @@ class CharTokenizer(Tokenizer):
 
     def encode(self, prompt, model_name):
         return [ord(c) for c in prompt], [(i, i + 1) for i in range(len(prompt))]
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "network: needs a real HF tokenizer (network or populated HF cache); "
+        "skips cleanly offline",
+    )
