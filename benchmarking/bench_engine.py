@@ -200,6 +200,63 @@ def main() -> int:
             }
         )
     )
+
+    # Speculative decoding (prompt-lookup): only pays off when greedy
+    # output echoes the context, so measure on a repetition-heavy workload
+    # (prompt = repeated pattern; greedy then tends to continue the cycle)
+    # against plain decode on the SAME workload, small batch (the regime
+    # where per-dispatch overhead dominates and spec's multi-token commits
+    # matter most). BENCH_SPEC=0 skips.
+    if os.environ.get("BENCH_SPEC", "1") != "0":
+        spec_batch = int(os.environ.get("BENCH_SPEC_BATCH", 4))
+        pattern = rng.integers(0, model_cfg.vocab_size, 12).tolist()
+
+        def spec_round(c) -> tuple[float, dict]:
+            eng = Engine(replace(c, decode_batch_size=spec_batch), params=params)
+            seqs = [
+                eng.add_request(
+                    pattern * 5 + pattern[: 2 + i],
+                    SamplingParams(max_new_tokens=max_new),
+                )
+                for i in range(spec_batch)
+            ]
+            while eng.has_work and any(s.num_generated == 0 for s in seqs):
+                eng.step()
+            gen0 = sum(s.num_generated for s in seqs)
+            t0 = time.perf_counter()
+            eng.run_until_complete()
+            dt = time.perf_counter() - t0
+            return (sum(s.num_generated for s in seqs) - gen0) / dt, dict(
+                eng.spec_stats
+            )
+
+        cfg_base = replace(cfg, decode_steps_per_iter=1)
+        cfg_spec = replace(
+            cfg, decode_steps_per_iter=1, spec_decode="prompt_lookup",
+            spec_k=4, spec_ngram=3,
+        )
+        spec_round(cfg_base)  # compile
+        base_tps, _ = spec_round(cfg_base)
+        spec_round(cfg_spec)  # compile verify shapes
+        spec_tps, stats = spec_round(cfg_spec)
+        acc = stats["accepted"] / max(stats["proposed"], 1)
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_throughput_spec",
+                    "value": round(spec_tps, 1),
+                    "unit": "tok/s",
+                    "model": mode,
+                    "decode_batch": spec_batch,
+                    "workload": "repetitive",
+                    "plain_same_workload": round(base_tps, 1),
+                    "vs_plain": round(spec_tps / max(base_tps, 1e-9), 3),
+                    "acceptance_rate": round(acc, 3),
+                    "verify_steps": stats["verify_steps"],
+                    "backend": jax.default_backend(),
+                }
+            )
+        )
     return 0
 
 

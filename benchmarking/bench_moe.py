@@ -46,6 +46,8 @@ def main() -> int:
         reps = 3
 
     dense_cfg = dataclasses.replace(cfg, moe_dispatch="dense")
+    xla_cfg = dataclasses.replace(cfg, moe_gmm="xla")
+    gmm_cfg = dataclasses.replace(cfg, moe_gmm="kernel")
     # BENCH_QUANT=int8: int8 EXPERT stacks (the opt-in path — the default
     # skips experts because this very benchmark showed the dequant doesn't
     # fuse into ragged_dot; results/moe_dispatch.md).
@@ -69,31 +71,62 @@ def main() -> int:
             "quantize": quant,
             "backend": jax.default_backend(),
         }
-        for name, c in (("routed", cfg), ("dense", dense_cfg)):
+        variants = (
+            ("routed", xla_cfg),  # ragged_dot (rounds 1-3 baseline)
+            ("gmm", gmm_cfg),  # Pallas grouped-matmul kernel (round 4)
+            ("dense", dense_cfg),
+        )
+        outs = {}
+        for name, c in variants:
             fn = jax.jit(lambda l, v, c=c: _moe_mlp(l, c, v))
             compiled = fn.lower(layer, x).compile()
             an = compiled.cost_analysis()
             an = an[0] if isinstance(an, list) else an
-            np.asarray(fn(layer, x)[0, 0, :1])  # warm + fence
+            outs[name] = np.asarray(fn(layer, x))  # warm + full fetch
             # Chain each call's output into the next input AND fence with a
             # device->host fetch: repeated identical dispatches can be
             # elided/overlapped by the runtime, and on the dev tunnel
             # block_until_ready returns before execution completes
             # (observed: "timings" 100x over hardware peak without these).
-            y = x
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                y = fn(layer, y)
-            np.asarray(y[0, 0, :1])
-            row[name + "_ms"] = round((time.perf_counter() - t0) / reps * 1e3, 3)
+            # Take the MIN of several timing rounds: the shared dev tunnel
+            # shows large sporadic stalls (same variant measured 8.8 ms and
+            # 476 ms minutes apart); min-of-rounds is the defensible
+            # device-time statistic under that noise.
+            best = float("inf")
+            for _ in range(3):
+                y = x
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    y = fn(layer, y)
+                np.asarray(y[0, 0, :1])
+                best = min(best, (time.perf_counter() - t0) / reps * 1e3)
+            row[name + "_ms"] = round(best, 3)
             row[name + "_gflops"] = round(an.get("flops", 0) / 1e9, 3)
-        row["value"] = row["routed_ms"]
-        row["speedup_vs_dense"] = round(row["dense_ms"] / row["routed_ms"], 2)
+        row["value"] = row["gmm_ms"]
+        row["gmm_speedup_vs_routed"] = round(row["routed_ms"] / row["gmm_ms"], 2)
+        row["speedup_vs_dense"] = round(row["dense_ms"] / row["gmm_ms"], 2)
+        # Effective grouped-matmul throughput (the 3 FFN matmuls' useful
+        # FLOPs over the kernel's wall time).
         if row["routed_gflops"]:
+            row["gmm_effective_tflops"] = round(
+                row["routed_gflops"] / row["gmm_ms"], 1
+            )
             row["flops_ratio_dense_over_routed"] = round(
                 row["dense_gflops"] / row["routed_gflops"], 1
             )
         print(json.dumps(row))
+        # On-chip numerics: the kernel must match the ragged_dot oracle
+        # (interpret-mode tests can't catch Mosaic miscompiles — the
+        # repo's own lesson, results/engine_throughput.md).
+        scale = np.abs(outs["routed"].astype(np.float32)).max() + 1e-9
+        err = (
+            np.abs(
+                outs["gmm"].astype(np.float32) - outs["routed"].astype(np.float32)
+            ).max()
+            / scale
+        )
+        tol = 5e-2 if quant else 2e-2
+        assert err < tol, f"gmm-vs-ragged mismatch: rel err {err:.4f} ({shape_name})"
         if on_tpu and shape_name == "prefill":
             assert row["flops_ratio_dense_over_routed"] > 8, row
     return 0

@@ -30,6 +30,7 @@ embeddings, decoupled head_dim).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -89,6 +90,90 @@ def _paged_attention_tp(
         out_specs=P(None, "tp"),
     )
     return fn(q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v)
+
+def _sp_prefill_attention(
+    q, k, v, k_pages_l, v_pages_l, block_tables, ctx_lens, positions, valid, mesh
+):
+    """Sequence-parallel prefill attention: ring over the chunk, exact
+    online-softmax merge with the paged prefix context.
+
+    The fresh chunk is sharded over the mesh's ``sp`` axis (contiguous
+    sequence shards). Each shard (a) runs a flash scan of its queries over
+    the paged context (pages replicated across sp; head-sharded across tp
+    exactly as in the tp paths), producing raw (m, l, acc) accumulators,
+    then (b) seeds the chunk ring with them
+    (``parallel/ring_attention.ring_attention_shard``) — K/V shards rotate
+    via ppermute (ICI-neighbor traffic only) and the merge is exact, so
+    the result matches the single-device online softmax over
+    [context ++ chunk] up to float associativity. Right-padded ``valid``
+    rides the ring as the key mask, so another shard's queries can never
+    attend a padded key.
+
+    Removes the single-chip compute/activation ceiling on chunk length —
+    the long-context serving path (SURVEY §5: sequence scaling lives in
+    the in-tree server; the reference never runs a model).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.attention import FLASH_KEY_BLOCK, _flash_over_keys
+    from ..parallel.mesh import shard_map_compat
+    from ..parallel.ring_attention import ring_attention_shard
+
+    has_tp = mesh.shape.get("tp", 1) > 1
+
+    def body(q, k, v, positions, valid, kp, vp, bt, cl):
+        b, s, n_q, d = q.shape
+        n_kv = k.shape[2]
+        group = n_q // n_kv
+        scale = d**-0.5
+        pos = positions.astype(jnp.int32)
+        max_ctx = bt.shape[1] * kp.shape[1]
+        qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
+        if max_ctx:
+            ctx_k = jnp.moveaxis(kp[bt].reshape(b, max_ctx, n_kv, d), 1, 2)
+            ctx_v = jnp.moveaxis(vp[bt].reshape(b, max_ctx, n_kv, d), 1, 2)
+            ctx_valid = jnp.arange(max_ctx)[None, :] < cl[:, None]
+            # Context strictly precedes the chunk: position -1 < any q_pos.
+            ctx_pos = jnp.full((b, max_ctx), -1, jnp.int32)
+            init = _flash_over_keys(
+                qf, ctx_k, ctx_v, ctx_valid, ctx_pos, pos, scale,
+                FLASH_KEY_BLOCK, return_accumulators=True,
+            )
+        else:
+            init = None
+        return ring_attention_shard(
+            q, k, v, axis_name="sp", scale=scale, q_pos=pos,
+            k_valid=valid, init_state=init,
+        )
+
+    head = "tp" if has_tp else None
+    qkv_spec = P(None, "sp", head, None)
+    seq_spec = P(None, "sp")
+    fn = shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            qkv_spec, qkv_spec, qkv_spec, seq_spec, seq_spec,
+            P(None, None, head, None), P(None, None, head, None),
+            P(), P(),
+        ),
+        out_specs=qkv_spec,
+    )
+    return fn(
+        q, k, v, positions, valid, k_pages_l, v_pages_l, block_tables, ctx_lens
+    )
+
+
+def _check_right_padded_mask(ok) -> None:
+    """Host-side assert for prefill's pallas mask contract (opt-in via
+    LLMD_CHECK_PREFILL_MASK; see ``prefill`` docstring)."""
+    if not bool(ok):
+        raise ValueError(
+            "prefill(attn_impl='pallas') requires a right-padded prefix "
+            "mask: valid[i] == (arange(s) < n_valid[i]); got a mask with "
+            "interior holes — use attn_impl='xla' for arbitrary masks"
+        )
+
 
 def _flash_prefill_tp(
     q, k, v, k_pages_l, v_pages_l, block_tables, ctx_lens, n_valid, *, mesh
@@ -154,6 +239,12 @@ class LlamaConfig:
     # einsum over ALL experts — the numerics oracle, and the layout that
     # GSPMD expert-parallel sharding partitions today).
     moe_dispatch: str = "routed"
+    # Grouped-matmul backend for the routed dispatch: "auto" (Pallas gmm
+    # kernel on TPU — megablox for bf16, in-VMEM-dequant kernel for int8
+    # experts — XLA ragged_dot elsewhere), "kernel" (force the Pallas
+    # path; interpret-mode off-TPU), or "xla" (force ragged_dot — the
+    # parity oracle). See ops/gmm.py and results/moe_dispatch.md.
+    moe_gmm: str = "auto"
     # Gemma-style variations: gated-GELU FFN ("gelu_tanh"), (1+w) RMSNorm
     # scaling (norm_offset=1.0), embeddings scaled by sqrt(hidden_size).
     hidden_act: str = "silu"
@@ -496,6 +587,37 @@ def _moe_mlp_dense(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarr
     )
 
 
+def _grouped_dot(cfg: LlamaConfig, row_group_ids: jnp.ndarray):
+    """Grouped-matmul dispatcher for the routed MoE paths.
+
+    Returns ``gdot(lhs, w, group_sizes)`` routing to the Pallas gmm kernel
+    (``ops/gmm.py`` — megablox for bf16, in-VMEM-dequant for int8 expert
+    stacks) per ``cfg.moe_gmm``, with ``jax.lax.ragged_dot`` as the XLA
+    fallback/oracle. ``row_group_ids`` is the sorted expert id per row —
+    needed to apply per-output-channel int8 scales on the kernel output.
+    """
+    from ..ops.gmm import grouped_matmul
+
+    if cfg.moe_gmm not in ("auto", "kernel", "xla"):
+        raise ValueError(f"unknown moe_gmm {cfg.moe_gmm!r}")
+    on_tpu = jax.default_backend() == "tpu"
+    use_kernel = cfg.moe_gmm == "kernel" or (cfg.moe_gmm == "auto" and on_tpu)
+
+    def gdot(lhs, w, group_sizes):
+        if not isinstance(w, QuantizedTensor):
+            w = _w(w, lhs.dtype)
+        return grouped_matmul(
+            lhs,
+            w,
+            group_sizes,
+            row_group_ids=row_group_ids,
+            interpret=not on_tpu,
+            use_kernel=use_kernel,
+        )
+
+    return gdot
+
+
 def _moe_mlp_routed(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
     """Routed sparse-MoE SwiGLU FFN: grouped top-k gather dispatch.
 
@@ -524,17 +646,12 @@ def _moe_mlp_routed(layer: Params, cfg: LlamaConfig, x: jnp.ndarray) -> jnp.ndar
     src_tok = token_ids[order]  # [n*k] token each sorted row came from
     xs = xf[src_tok]  # [n*k, d] gathered inputs, expert-contiguous
     group_sizes = jnp.bincount(expert_ids, length=cfg.n_experts)
+    gdot = _grouped_dot(cfg, expert_ids[order])
 
-    gate = cfg.act_fn(
-        jax.lax.ragged_dot(xs, _w(layer["w_gate"], x.dtype), group_sizes).astype(
-            jnp.float32
-        )
-    )
-    up = jax.lax.ragged_dot(xs, _w(layer["w_up"], x.dtype), group_sizes).astype(
-        jnp.float32
-    )
+    gate = cfg.act_fn(gdot(xs, layer["w_gate"], group_sizes).astype(jnp.float32))
+    up = gdot(xs, layer["w_up"], group_sizes).astype(jnp.float32)
     act = (gate * up).astype(x.dtype)
-    out = jax.lax.ragged_dot(act, _w(layer["w_down"], x.dtype), group_sizes)  # [n*k, d]
+    out = gdot(act, layer["w_down"], group_sizes)  # [n*k, d]
 
     out = out.astype(jnp.float32) * topv.reshape(-1)[order][:, None]
     combined = jnp.zeros((n, d), jnp.float32).at[src_tok].add(out)
@@ -593,19 +710,15 @@ def _moe_mlp_routed_ep(
         src_tok = token_ids[order]
         xg = xf[src_tok]  # [n*k, d] expert-contiguous
         group_sizes = jnp.bincount(expert_ids, length=e_local)
+        # QuantizedTensor expert shards flow into the gmm kernel as-is
+        # (specs are pytree prefixes, so q and scale both shard on E);
+        # the kernel dequantizes per-tile in VMEM.
+        gdot = _grouped_dot(cfg, expert_ids[order])
 
-        # _w: dequantize int8 expert shards locally (specs are pytree
-        # prefixes, so a QuantizedTensor's q and scale both shard on E).
-        gate = cfg.act_fn(
-            jax.lax.ragged_dot(xg, _w(w_gate, xs.dtype), group_sizes).astype(
-                jnp.float32
-            )
-        )
-        up = jax.lax.ragged_dot(xg, _w(w_up, xs.dtype), group_sizes).astype(
-            jnp.float32
-        )
+        gate = cfg.act_fn(gdot(xg, w_gate, group_sizes).astype(jnp.float32))
+        up = gdot(xg, w_up, group_sizes).astype(jnp.float32)
         act = (gate * up).astype(xs.dtype)
-        out = jax.lax.ragged_dot(act, _w(w_down, xs.dtype), group_sizes)  # [n*k, d]
+        out = gdot(act, w_down, group_sizes)  # [n*k, d]
 
         out = out.astype(jnp.float32) * gate_w.reshape(-1)[order][:, None]
         combined = jnp.zeros((n, d), jnp.float32).at[src_tok].add(out)
@@ -708,7 +821,7 @@ def _scatter_kv_pages_all_layers(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh", "attn_impl"),
+    static_argnames=("cfg", "mesh", "attn_impl", "return_all_logits"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def prefill(
@@ -725,6 +838,7 @@ def prefill(
     ctx_lens: jnp.ndarray,  # [b] int32 — prefix-cached context length (0 = fresh)
     mesh=None,  # tp mesh for expert-parallel MoE dispatch
     attn_impl: str = "xla",  # "xla" (scan flash) | "pallas" (flash kernel)
+    return_all_logits: bool = False,  # [b, s, vocab] for spec-decode verify
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Process a prompt chunk: returns (logits at last valid position per
     sequence [b, vocab], updated k_pages, v_pages).
@@ -733,13 +847,34 @@ def prefill(
     prefix-cached context already resident in the page pool — this is how a
     prefix-cache hit skips recomputing the shared prefix. Fresh sequences
     pass ``ctx_lens = 0``.
+
+    Mask contract: ``valid`` must be a RIGHT-PADDED prefix mask — per row,
+    ``valid[i] == (arange(s) < n_valid[i])``. The ``xla`` path honors an
+    arbitrary mask exactly, but the ``pallas`` kernel collapses it to a
+    per-sequence count, so a mask with interior holes silently computes
+    wrong attention on ``attn_impl="pallas"``. The engine always satisfies
+    this; non-engine callers can set ``LLMD_CHECK_PREFILL_MASK=1`` to
+    verify at runtime (host-callback assert; small sync cost — debug only).
+    The flag is read at jit TRACE time: set it before the first prefill
+    call of a given shape (or call ``prefill.clear_cache()``) — flipping it
+    after a shape is compiled has no effect on that cached trace.
     """
     if attn_impl not in ("xla", "pallas"):
         raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if sp > 1 and tokens.shape[1] % sp != 0:
+        raise ValueError(
+            f"chunk length {tokens.shape[1]} not divisible by sp={sp}"
+        )
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     h = _embed(params, cfg, tokens)  # [b, s, d]
     if attn_impl == "pallas":
         n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
+        if os.environ.get("LLMD_CHECK_PREFILL_MASK"):
+            contract = jnp.arange(valid.shape[1])[None, :] < n_valid[:, None]
+            jax.debug.callback(
+                _check_right_padded_mask, jnp.all(contract == valid)
+            )
 
     fresh_k = []  # per-layer [b, s, n_kv, hd] — written to pages in one go
     fresh_v = []
@@ -749,7 +884,16 @@ def prefill(
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
 
-        if attn_impl == "pallas":
+        if sp > 1:
+            # Sequence-parallel chunk: ring attention over the sp axis,
+            # merged exactly with the paged context (see
+            # _sp_prefill_attention). Takes precedence over attn_impl —
+            # the ring is the sharded equivalent of the xla flash scan.
+            attn = _sp_prefill_attention(
+                q, k, v, k_pages[li], v_pages[li], block_tables, ctx_lens,
+                positions, valid, mesh,
+            )
+        elif attn_impl == "pallas":
             # Flash kernel (ops/flash_prefill.py). Engine contract:
             # consecutive chunk positions, right-padded valid mask.
             attn = _flash_prefill_tp(
@@ -781,6 +925,12 @@ def prefill(
         v_pages, jnp.stack(fresh_v).astype(v_pages.dtype), page_ids, slot_ids, valid
     )
 
+    if return_all_logits:
+        # Every chunk position's next-token logits [b, s, vocab] — the
+        # speculative-decode verify step scores all k+1 proposed tokens in
+        # this one dispatch (chunks there are tiny, so the full-position
+        # lm_head stays cheap).
+        return _logits(params, cfg, h), k_pages, v_pages
     # Logits at each sequence's last valid position.
     last_idx = jnp.maximum(jnp.sum(valid.astype(jnp.int32), axis=1) - 1, 0)  # [b]
     h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [b, d]

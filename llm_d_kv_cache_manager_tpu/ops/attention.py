@@ -76,11 +76,17 @@ def _flash_over_keys(
     q_pos: jnp.ndarray,  # [b, s] int32
     scale: float,
     block: int,
+    return_accumulators: bool = False,
 ) -> jnp.ndarray:
     """Online-softmax (flash) attention over a virtual key sequence, scanned
     in key blocks so the [s, T] score matrix is never materialized — the
     memory shape XLA wants for long-context prefill on TPU (score tile
-    [s, block] is reused across scan iterations)."""
+    [s, block] is reused across scan iterations).
+
+    With ``return_accumulators`` the raw flash state ``(m, l, acc)`` is
+    returned instead of the normalized output, so a caller can merge this
+    partial attention with another key range exactly (the sp-prefill path
+    merges paged-context accumulators into the chunk's ring)."""
     b, s, n_kv, group, d = qf.shape
     T = k_all.shape[2]
     # Short key sequences (cache-cold short prompts) shrink the block to a
@@ -124,6 +130,8 @@ def _flash_over_keys(
         return (m_new, l, acc), None
 
     (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (kb, vb, valb, posb))
+    if return_accumulators:
+        return m, l, acc
     out = acc / jnp.where(l > 0, l, 1.0)[..., None]
     # [b, n_kv, g, s, d] -> [b, s, n_kv, g, d]
     return out.transpose(0, 3, 1, 2, 4)

@@ -1,0 +1,238 @@
+"""Grouped (ragged) matmul for MoE routed dispatch on the MXU.
+
+The routed MoE pipeline (``models/llama._moe_mlp_routed``) sorts the
+``n*k`` (token, slot) rows by expert so each expert's rows form one
+contiguous segment, then needs ``out[r] = lhs[r] @ rhs[g(r)]`` where
+``g(r)`` is the expert owning row ``r``. ``jax.lax.ragged_dot`` expresses
+this but runs far below MXU utilization at our shapes (~19 TFLOP/s
+effective vs the dense einsum's ~141 at Qwen3-30B geometry —
+``benchmarking/results/moe_dispatch.md``), and XLA does not fuse int8
+dequantization into its group-streamed operand, making int8 experts 2.5×
+SLOWER than bf16 there.
+
+Two kernels, one wrapper:
+
+- **bf16/f32**: the Pallas megablox ``gmm``
+  (``jax.experimental.pallas.ops.tpu.megablox`` — tiled MXU grouped
+  matmul; boundary tiles are visited once per intersecting group with
+  masked stores, so there is no capacity padding and no dropped tokens).
+- **int8 experts** (``QuantizedTensor`` rhs): our own kernel below, same
+  tiling scheme, with the two int8-specific pieces megablox rejects:
+  the int8 payload tile is DMA'd at half the HBM bytes and converted to
+  f32 IN VMEM right before the MXU dot (the fusion ``ragged_dot``
+  can't do), and the per-output-channel scale — constant along the
+  contraction axis, so it commutes out of the dot — is applied as a
+  per-row gathered multiply on the output, where XLA fuses it into the
+  consuming elementwise ops.
+
+No reference counterpart: the reference delegates model execution to
+vLLM; this is in-tree TPU serving work (SURVEY §7 stage 4-5).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.quant import QuantizedTensor
+
+#: (tm, tk, tn) tile-size ceilings, from the on-chip sweep at Qwen3-30B
+#: geometry (128 experts, d=2048, f=768, 16k rows): 256-row tiles balance
+#: boundary-visit waste (visits ≈ max(m_tiles, nonempty groups) whatever
+#: tm is) against MXU pipeline depth, and large tk/tn cut grid steps —
+#: (256,1024,768) measured 5.0 ms vs 7.1 ms at (256,512,512) and 7.5 ms
+#: at (512,512,512) for one 16k-row grouped matmul. Tiles stay well
+#: under VMEM (rhs tile 1.5 MB bf16).
+DEFAULT_TILING = (256, 1024, 768)
+
+
+def _round8(m: int) -> int:
+    return -(-m // 8) * 8
+
+
+def _divisor_tile(dim: int, cap: int) -> int:
+    """Largest lane-aligned tile <= cap that divides ``dim`` exactly (the
+    int8 kernel skips remainder-tile masking); falls back to ``dim``."""
+    for t in range(min(cap, dim), 127, -128):
+        if dim % t == 0:
+            return t
+    return dim
+
+
+def grouped_matmul(
+    lhs: jnp.ndarray,  # [rows, d] group-sorted (expert-contiguous) rows
+    rhs: Union[jnp.ndarray, QuantizedTensor],  # [E, d, f] expert stack
+    group_sizes: jnp.ndarray,  # [E] int32 rows per expert
+    *,
+    row_group_ids: Optional[jnp.ndarray] = None,  # [rows] expert of row
+    interpret: bool = False,
+    use_kernel: bool = True,
+) -> jnp.ndarray:
+    """``out[r] = lhs[r] @ rhs[g(r)]`` over expert-contiguous rows.
+
+    With a ``QuantizedTensor`` rhs, ``row_group_ids`` (the sorted expert id
+    per row — the caller already has it) is required to apply the
+    per-output-channel scales to the output rows.
+
+    ``use_kernel=False`` falls back to ``jax.lax.ragged_dot`` with
+    whole-stack dequantization — the parity oracle for tests.
+    """
+    if isinstance(rhs, QuantizedTensor):
+        if row_group_ids is None:
+            raise ValueError("row_group_ids required for quantized rhs")
+        q, scale = rhs.q, rhs.scale  # [E, d, f] int8, [E, 1, f] f32
+        if not use_kernel:
+            w = q.astype(lhs.dtype) * scale.astype(lhs.dtype)
+            return jax.lax.ragged_dot(lhs, w, group_sizes)
+        out = _gmm_int8(lhs, q, group_sizes, interpret=interpret)  # f32
+        # Per-row scale: scale[g(r), 0, :] — fuses downstream.
+        row_scale = scale[row_group_ids, 0, :]  # [rows, f]
+        return (out * row_scale).astype(lhs.dtype)
+    if not use_kernel:
+        return jax.lax.ragged_dot(lhs, rhs, group_sizes)
+    return _gmm_library(lhs, rhs, group_sizes, interpret=interpret)
+
+
+def _gmm_library(lhs, rhs, group_sizes, *, interpret: bool):
+    from jax.experimental.pallas.ops.tpu.megablox import gmm as mb_gmm
+
+    rows, d = lhs.shape
+    f = rhs.shape[2]
+    tm, tk, tn = DEFAULT_TILING
+    tm = min(tm, max(_round8(rows), 8))
+    # megablox requires m % tm == 0: pad rows (beyond every group — the
+    # pad region's output is garbage and sliced off).
+    pad = (-rows) % tm
+    if pad:
+        lhs = jnp.pad(lhs, ((0, pad), (0, 0)))
+    out = mb_gmm(
+        lhs,
+        rhs,
+        group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.float32,
+        tiling=(tm, _divisor_tile(d, tk), _divisor_tile(f, tn)),
+        interpret=interpret,
+    )
+    return out[:rows].astype(lhs.dtype)
+
+
+# -- int8-rhs grouped matmul kernel --------------------------------------
+#
+# Same scheme as megablox gmm: grid (tiles_n, active_m_tiles, tiles_k)
+# where the middle dimension walks (m-tile, group) intersections in row
+# order — a boundary m-tile spanning G groups is visited G times, each
+# visit computing the full tile on the MXU but storing only its own
+# group's rows. Group metadata (which group / which m-tile per grid step)
+# comes from the library's make_group_metadata; lhs rows are pre-padded to
+# a tile multiple and the pad region (beyond every group) is sliced off.
+
+
+def _int8_gmm_kernel(
+    group_metadata, lhs_ref, q_ref, out_ref, acc_ref, *, tiles_k, tm, tn
+):
+    group_offsets, group_ids, m_tile_ids = group_metadata
+    grid_id = pl.program_id(1)
+    k_i = pl.program_id(2)
+
+    @pl.when(k_i == 0)
+    def _zero_acc():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # int8 tile -> f32 happens HERE, in VMEM: HBM only ever streams the
+    # 1-byte payload.
+    acc_ref[...] += jax.lax.dot_general(
+        lhs_ref[...].astype(jnp.float32),
+        q_ref[...].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k_i == tiles_k - 1)
+    def _store():
+        # Store only this visit's group rows; preserve rows written by the
+        # other groups sharing this m-tile (visited at adjacent grid ids).
+        group_id = group_ids[grid_id]
+        start = group_offsets[group_id]
+        end = group_offsets[group_id + 1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (tm, tn), 0) + (
+            m_tile_ids[grid_id] * tm
+        )
+        mask = (row >= start) & (row < end)
+        out_ref[...] = jax.lax.select(mask, acc_ref[...], out_ref[...])
+
+
+def _gmm_int8(lhs, q, group_sizes, *, interpret: bool):
+    """Grouped matmul with an int8 expert stack; returns f32 [rows, f].
+
+    Scales are NOT applied here — per-output-channel scales commute out of
+    the contraction and are cheaper as a fused elementwise on the output.
+    """
+    from jax.experimental.pallas.ops.tpu.megablox.gmm import make_group_metadata
+
+    rows, d = lhs.shape
+    n_groups, _, f = q.shape
+    tm = min(DEFAULT_TILING[0], max(_round8(rows), 8))
+    tk = _divisor_tile(d, DEFAULT_TILING[1])
+    tn = _divisor_tile(f, DEFAULT_TILING[2])
+    tiles_k = d // tk
+    tiles_n = f // tn
+
+    pad = (-rows) % tm
+    m = rows + pad
+    if pad:
+        lhs = jnp.pad(lhs, ((0, pad), (0, 0)))
+
+    group_metadata, num_active_tiles = make_group_metadata(
+        group_sizes=group_sizes.astype(jnp.int32),
+        m=m,
+        tm=tm,
+        start_group=jnp.asarray(0, jnp.int32),
+        num_nonzero_groups=n_groups,
+        visit_empty_groups=False,
+    )
+
+    def lhs_index(n_i, grid_id, k_i, meta):
+        _, _, m_tile_ids = meta
+        del n_i
+        return m_tile_ids[grid_id], k_i
+
+    def q_index(n_i, grid_id, k_i, meta):
+        _, group_ids, _ = meta
+        return group_ids[grid_id], k_i, n_i
+
+    def out_index(n_i, grid_id, k_i, meta):
+        _, _, m_tile_ids = meta
+        del k_i
+        return m_tile_ids[grid_id], n_i
+
+    flops = 2 * m * d * f
+    bytes_accessed = (
+        lhs.size * lhs.itemsize * tiles_n + d * f * q.itemsize + m * f * 4
+    )
+    out = pl.pallas_call(
+        functools.partial(_int8_gmm_kernel, tiles_k=tiles_k, tm=tm, tn=tn),
+        out_shape=jax.ShapeDtypeStruct((m, f), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            in_specs=[
+                pl.BlockSpec((tm, tk), lhs_index),
+                pl.BlockSpec((None, tk, tn), q_index),
+            ],
+            out_specs=pl.BlockSpec((tm, tn), out_index),
+            grid=(tiles_n, num_active_tiles, tiles_k),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=flops, bytes_accessed=bytes_accessed, transcendentals=0
+        ),
+        interpret=interpret,
+    )(group_metadata, lhs, q)
+    return out[:rows]

@@ -26,10 +26,15 @@ from jax.sharding import Mesh
 class MeshConfig:
     dp: int = 1
     tp: int = 1
+    #: sequence-parallel degree (ring attention over the "sp" axis for
+    #: long-context prefill; see parallel/ring_attention.py). Placed
+    #: between dp and tp so ring neighbors are ICI-adjacent within a
+    #: dp replica.
+    sp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.sp * self.tp
 
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs, check: bool = False):
@@ -50,14 +55,14 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs, check: bool = False):
 
 
 def make_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
-    """Build a (dp, tp) mesh over the given devices (default: all)."""
+    """Build a (dp, sp, tp) mesh over the given devices (default: all)."""
     cfg = config or MeshConfig()
     if devices is None:
         devices = jax.devices()
     if len(devices) < cfg.n_devices:
         raise ValueError(
-            f"mesh needs {cfg.n_devices} devices (dp={cfg.dp} × tp={cfg.tp}), "
-            f"have {len(devices)}"
+            f"mesh needs {cfg.n_devices} devices (dp={cfg.dp} × sp={cfg.sp} "
+            f"× tp={cfg.tp}), have {len(devices)}"
         )
-    grid = np.asarray(devices[: cfg.n_devices]).reshape(cfg.dp, cfg.tp)
-    return Mesh(grid, axis_names=("dp", "tp"))
+    grid = np.asarray(devices[: cfg.n_devices]).reshape(cfg.dp, cfg.sp, cfg.tp)
+    return Mesh(grid, axis_names=("dp", "sp", "tp"))

@@ -40,11 +40,14 @@ _NEG_INF = -1e30
 def _ring_body(carry, _, *, axis_name, qf, q_pos, scale, n_shards):
     """One ring step: attend my query shard to the K/V shard currently held,
     then pass that shard to the next device on the ring."""
-    k_cur, v_cur, kpos_cur, m, l, acc = carry
+    k_cur, v_cur, kpos_cur, kvalid_cur, m, l, acc = carry
 
     # [b, n_kv, g, s_q, s_k] score tile for this step.
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32)) * scale
-    mask = kpos_cur[:, None, None, None, :] <= q_pos[:, None, None, :, None]
+    mask = (
+        kvalid_cur[:, None, None, None, :]
+        & (kpos_cur[:, None, None, None, :] <= q_pos[:, None, None, :, None])
+    )
     scores = jnp.where(mask, scores, _NEG_INF)
 
     m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -55,12 +58,13 @@ def _ring_body(carry, _, *, axis_name, qf, q_pos, scale, n_shards):
         "bhgqk,bkhd->bhgqd", p, v_cur.astype(jnp.float32)
     )
 
-    # Rotate K/V/pos to the next device; neighbor-only ICI traffic.
+    # Rotate K/V/pos/validity to the next device; neighbor-only ICI traffic.
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
     v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
     kpos_nxt = jax.lax.ppermute(kpos_cur, axis_name, perm)
-    return (k_nxt, v_nxt, kpos_nxt, m_new, l_new, acc_new), None
+    kvalid_nxt = jax.lax.ppermute(kvalid_cur, axis_name, perm)
+    return (k_nxt, v_nxt, kpos_nxt, kvalid_nxt, m_new, l_new, acc_new), None
 
 
 def ring_attention_shard(
@@ -70,9 +74,21 @@ def ring_attention_shard(
     *,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    q_pos: Optional[jnp.ndarray] = None,  # [b, s_shard] absolute positions
+    k_valid: Optional[jnp.ndarray] = None,  # [b, s_shard] key padding mask
+    init_state: Optional[tuple] = None,  # (m, l, acc) seed — e.g. paged ctx
 ) -> jnp.ndarray:
     """Per-shard ring attention body. Must run inside ``shard_map`` (or pmap)
-    over ``axis_name``; q/k/v are this device's sequence shard."""
+    over ``axis_name``; q/k/v are this device's sequence shard.
+
+    Defaults reproduce plain causal self-attention over the global
+    sequence (positions derived from the shard index). The engine's
+    sp-prefill passes explicit ``q_pos`` (chunk tokens sit after a
+    prefix-cached context), a ``k_valid`` padding mask (right-padded
+    chunks), and ``init_state`` accumulators holding the paged-context
+    partial attention — the ring merge is exact, so the result equals a
+    single-device online softmax over [context ++ chunk].
+    """
     b, s, n_q, d = q.shape
     n_kv = k.shape[2]
     group = n_q // n_kv
@@ -81,14 +97,20 @@ def ring_attention_shard(
     n_shards = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
 
-    q_pos = (my * s + jnp.arange(s))[None, :].astype(jnp.int32)
-    q_pos = jnp.broadcast_to(q_pos, (b, s))
+    if q_pos is None:
+        q_pos = (my * s + jnp.arange(s))[None, :].astype(jnp.int32)
+        q_pos = jnp.broadcast_to(q_pos, (b, s))
     k_pos = q_pos  # at step 0 each device holds its own K shard
+    if k_valid is None:
+        k_valid = jnp.ones((b, s), bool)
 
     qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
-    m0 = jnp.full((b, n_kv, group, s), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, n_kv, group, s), jnp.float32)
-    acc0 = jnp.zeros((b, n_kv, group, s, d), jnp.float32)
+    if init_state is None:
+        m0 = jnp.full((b, n_kv, group, s), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, n_kv, group, s), jnp.float32)
+        acc0 = jnp.zeros((b, n_kv, group, s, d), jnp.float32)
+    else:
+        m0, l0, acc0 = init_state
 
     body = partial(
         _ring_body,
@@ -98,8 +120,8 @@ def ring_attention_shard(
         scale=scale,
         n_shards=n_shards,
     )
-    (_, _, _, m, l, acc), _ = jax.lax.scan(
-        body, (k, v, k_pos, m0, l0, acc0), None, length=n_shards
+    (_, _, _, _, m, l, acc), _ = jax.lax.scan(
+        body, (k, v, k_pos, k_valid, m0, l0, acc0), None, length=n_shards
     )
 
     out = acc / jnp.where(l > 0, l, 1.0)[..., None]

@@ -85,6 +85,14 @@ class EngineConfig:
     #: everything else is GSPMD-partitioned by XLA. Requires
     #: n_heads % tp == 0 and n_kv_heads % tp == 0.
     tp: int = 1
+    #: sequence-parallel degree for PREFILL: the fresh chunk is sharded
+    #: over an "sp" mesh axis and attended via ring attention with an
+    #: exact paged-context merge (models/llama._sp_prefill_attention) —
+    #: the long-context path for prompts whose chunk would blow a single
+    #: chip's compute/activation budget. Decode stays tp-only (one token
+    #: per lane has nothing to shard). Composes with tp (mesh is sp × tp);
+    #: requires sp | prefill_bucket.
+    sp: int = 1
     #: pipeline fused decode bursts: dispatch burst N+1 (input tokens
     #: chained on-device from burst N's last sampled token) BEFORE
     #: fetching/committing burst N, hiding per-iteration host work
@@ -99,15 +107,33 @@ class EngineConfig:
     #: prefill attention implementation: "auto" (Pallas flash kernel on
     #: TPU, XLA scan elsewhere), "pallas", or "xla".
     prefill_attn: str = "auto"
+    #: speculative decoding: "off" or "prompt_lookup" (draft-model-free —
+    #: propose the continuation of the context's own last n-gram from an
+    #: earlier occurrence; accept via one verify dispatch that scores all
+    #: k+1 tokens — exactly a warm prefill over [context ++ proposals]).
+    #: Applies to batches where every lane is greedy (temperature 0);
+    #: sampled batches fall back to the normal decode path (spec sampling
+    #: for temperature>0 is not implemented).
+    spec_decode: str = "off"
+    #: proposed tokens per verify step (accepted 0..k, +1 corrected/bonus
+    #: token always emitted — a spec step never yields fewer tokens than a
+    #: normal decode step).
+    spec_k: int = 4
+    #: n-gram length to match for prompt-lookup proposals
+    spec_ngram: int = 3
+    #: cap on how far back the proposal search scans (host-side cost)
+    spec_max_scan: int = 4096
     #: weight quantization: None (serve in model dtype) or "int8"
     #: (symmetric per-output-channel weight-only int8 — halves weight HBM
     #: bytes so 8B-class models fit one v5e chip with a KV pool;
     #: see models/quant.py). Applied to whatever params the engine gets,
     #: random-init or checkpoint-loaded.
     quantize: Optional[str] = None
-    #: also quantize MoE expert stacks. Off by default: measured SLOWER
-    #: (dequant doesn't fuse into ragged_dot, results/moe_dispatch.md);
-    #: opt in only where HBM capacity forces it.
+    #: also quantize MoE expert stacks. Off by default (conservative:
+    #: expert numerics are routing-sensitive); with the round-4 gmm kernel
+    #: int8 experts run ≈ bf16 speed (in-VMEM dequant,
+    #: results/moe_dispatch.md) while halving expert HBM — opt in where
+    #: capacity matters.
     quantize_experts: bool = False
     seed: int = 0
 
@@ -168,22 +194,40 @@ class Engine:
                 )
         if config.prefill_attn not in ("auto", "pallas", "xla"):
             raise ValueError(f"unknown prefill_attn {config.prefill_attn!r}")
+        if config.spec_decode not in ("off", "prompt_lookup"):
+            raise ValueError(f"unknown spec_decode {config.spec_decode!r}")
+        if config.spec_decode != "off":
+            if config.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if config.spec_ngram < 1:
+                raise ValueError("spec_ngram must be >= 1")
+        #: speculative-decode observability: proposed/accepted draft tokens
+        #: and verify dispatches (acceptance rate = accepted/proposed).
+        self.spec_stats = {"proposed": 0, "accepted": 0, "verify_steps": 0}
         self.prefill_attn = config.prefill_attn
         if self.prefill_attn == "auto":
             self.prefill_attn = (
                 "pallas" if jax.default_backend() == "tpu" else "xla"
             )
         self.mesh = None
-        if config.tp > 1:
+        if config.tp > 1 or config.sp > 1:
             if cfg.n_heads % config.tp or cfg.n_kv_heads % config.tp:
                 raise ValueError(
                     f"tp={config.tp} must divide n_heads={cfg.n_heads} and "
                     f"n_kv_heads={cfg.n_kv_heads}"
                 )
+            if config.sp > 1 and config.prefill_bucket % config.sp:
+                raise ValueError(
+                    f"sp={config.sp} must divide "
+                    f"prefill_bucket={config.prefill_bucket} (chunk lengths "
+                    f"are bucket multiples and must shard evenly)"
+                )
             from ..parallel import MeshConfig, make_mesh, shard_params
             from ..parallel.sharding import kv_pages_sharding
 
-            self.mesh = make_mesh(MeshConfig(dp=1, tp=config.tp))
+            self.mesh = make_mesh(
+                MeshConfig(dp=1, sp=config.sp, tp=config.tp)
+            )
             params = shard_params(params, self.mesh, cfg)
         self.params = params
         self.k_pages, self.v_pages = llama.init_kv_pages(
@@ -445,6 +489,20 @@ class Engine:
         return min(self.max_pages_per_seq, _round_up(used, bucket))
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
+        if self.config.spec_decode == "prompt_lookup" and all(
+            s.sampling.temperature == 0 for s in seqs
+        ):
+            # Commit lag: the drain can finish lanes — never reserve for or
+            # dispatch a finished sequence (same rule as the fused path).
+            self._drain_inflight()
+            seqs = [s for s in seqs if not self._should_finish(s)]
+            if not seqs:
+                return
+            if self._run_decode_spec(seqs):
+                return
+            # Every lane's proposal came up empty: a verify dispatch would
+            # emit exactly one token at prefill-dispatch cost — fall
+            # through to the strictly cheaper plain/fused decode step.
         if self.config.decode_steps_per_iter > 1:
             self._run_decode_fused(seqs)
             return
@@ -652,6 +710,146 @@ class Engine:
             self._inflight = burst
         else:
             self._commit_burst(burst)
+
+    def _propose_prompt_lookup(self, seq: Sequence) -> list[int]:
+        """Draft-model-free proposals: find the latest earlier occurrence of
+        the context's final ``spec_ngram`` tokens and propose the tokens
+        that followed it (classic prompt-lookup decoding — strongest on
+        extractive/structured generations where the output echoes the
+        prompt). Host-side, O(spec_max_scan)."""
+        n = self.config.spec_ngram
+        k = self.config.spec_k
+        toks = seq.all_tokens
+        if len(toks) < n + 1:
+            return []
+        pattern = toks[-n:]
+        lo = max(0, len(toks) - 1 - self.config.spec_max_scan)
+        # Latest match wins (recency correlates with continuation quality);
+        # the terminal occurrence itself (start == len-n) is excluded.
+        for start in range(len(toks) - n - 1, lo - 1, -1):
+            if toks[start : start + n] == pattern:
+                return [int(t) for t in toks[start + n : start + n + k]]
+        return []
+
+    def _run_decode_spec(self, seqs: list[Sequence]) -> bool:
+        """Speculative decode via prompt-lookup: ONE verify dispatch scores
+        the last committed token plus up to ``spec_k`` proposed tokens —
+        exactly a warm prefill over [paged context ++ chunk] (the chunk is
+        [t_last, d_1..d_m], positions from num_tokens-1, context =
+        num_tokens-1 committed tokens) with full-position logits. The
+        longest proposal prefix matching the model's own greedy choices is
+        accepted, plus the model's token at the first mismatch (or a bonus
+        token when everything matched) — so a step emits 1..k+1 tokens and
+        never fewer than plain decode. Returns False (nothing dispatched)
+        when every lane's proposal is empty; the caller then runs the
+        cheaper plain/fused step.
+
+        Emitted tokens are the model's own greedy choices as scored by the
+        PREFILL path; in interpret/XLA numerics that is bit-identical to
+        plain greedy decode (the parity the tests pin). On-chip, verify
+        (flash-prefill kernel) and plain decode (paged-attention kernel)
+        reduce in different orders, so a near-tie can resolve differently
+        — outputs remain exact greedy samples of the verify logits, but
+        cross-path bit-equality is not guaranteed on TPU.
+
+        Rejected drafts leave stale K/V in slots the sequence already owns
+        beyond ``num_computed``; nothing ever attends past ``seq_len`` and
+        page registration is bounded by ``num_computed``, so rollback is
+        pure bookkeeping (same safety argument as fused-decode surplus
+        tokens)."""
+        import math
+
+        ps = self.page_size
+        k = self.config.spec_k
+        # Chunk width must satisfy both the lane alignment and the sp
+        # sharding of the prefill path.
+        s_chunk = _round_up(k + 1, math.lcm(8, max(1, self.config.sp)))
+        b = self.config.decode_batch_size
+        assert len(seqs) <= b
+
+        # Proposals are host-side and cheap: compute BEFORE reserving so an
+        # all-empty round costs nothing (caller falls back to plain decode).
+        prop_by_id = {s.seq_id: self._propose_prompt_lookup(s) for s in seqs}
+        if not any(prop_by_id.values()):
+            return False
+
+        # Reserve growth for the whole chunk before building tables (can
+        # preempt batchmates — or abort; both leave block_table empty).
+        for seq in seqs:
+            if seq.block_table:
+                self._reserve_slots_or_preempt(seq, s_chunk)
+        active = [s for s in seqs if s.block_table]
+        if not active:
+            return True
+
+        proposals = [prop_by_id[s.seq_id] for s in active]
+
+        tokens = np.zeros((b, s_chunk), np.int32)
+        positions = np.zeros((b, s_chunk), np.int32)
+        valid = np.zeros((b, s_chunk), bool)
+        page_ids = np.zeros((b, s_chunk), np.int32)
+        slot_ids = np.zeros((b, s_chunk), np.int32)
+        max_ctx = max((s.num_tokens - 1) // ps + 1 for s in active)
+        ctx_pages = _round_up(max_ctx, max(1, self.config.decode_pages_bucket))
+        ctx_bt = np.zeros((b, ctx_pages), np.int32)
+        ctx_lens = np.zeros((b,), np.int32)
+
+        for i, (seq, prop) in enumerate(zip(active, proposals)):
+            n_chunk = 1 + len(prop)
+            tokens[i, 0] = seq.all_tokens[-1]
+            tokens[i, 1 : n_chunk] = prop
+            start = seq.num_tokens - 1  # last committed token's position
+            pos = np.arange(start, start + n_chunk)
+            positions[i, :n_chunk] = pos
+            valid[i, :n_chunk] = True
+            bt = np.asarray(seq.block_table, np.int32)
+            page_ids[i, :n_chunk] = bt[pos // ps]
+            slot_ids[i, :n_chunk] = pos % ps
+            n_ctx = (start // ps) + (1 if start % ps else 0)
+            ctx_bt[i, :n_ctx] = bt[:n_ctx]
+            ctx_lens[i] = start
+
+        self._flush_page_moves()
+        logits, self.k_pages, self.v_pages = llama.prefill(
+            self.params,
+            self.model_cfg,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(valid),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(page_ids),
+            jnp.asarray(slot_ids),
+            jnp.asarray(ctx_bt),
+            jnp.asarray(ctx_lens),
+            mesh=self.mesh,
+            attn_impl=self.prefill_attn,
+            return_all_logits=True,
+        )
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [b, s_chunk]
+
+        self.spec_stats["verify_steps"] += 1
+        for i, (seq, prop) in enumerate(zip(active, proposals)):
+            if not seq.block_table:
+                continue  # preempted by a batchmate's reservation
+            accepted = 0
+            while accepted < len(prop) and prop[accepted] == int(
+                greedy[i, accepted]
+            ):
+                accepted += 1
+            self.spec_stats["proposed"] += len(prop)
+            self.spec_stats["accepted"] += accepted
+            # Accepted drafts + the model's token at the first mismatch
+            # (bonus token when every draft matched).
+            emit = prop[:accepted] + [int(greedy[i, accepted])]
+            for tok in emit:
+                if self._should_finish(seq):
+                    break
+                seq.num_computed = seq.num_tokens
+                seq.output_tokens.append(tok)
+                seq.num_generated += 1
+            self.block_manager.register_full_pages(seq)
+        return True
 
     def _drain_inflight(self) -> None:
         if self._inflight is None:

@@ -137,6 +137,8 @@ class PodServerConfig:
         )
         eng.max_model_len = int(os.environ.get("MAX_MODEL_LEN", eng.max_model_len))
         eng.tp = int(os.environ.get("TP", eng.tp))
+        # Sequence-parallel prefill degree (ring attention; long prompts).
+        eng.sp = int(os.environ.get("SP", eng.sp))
         eng.decode_batch_size = int(
             os.environ.get("DECODE_BATCH_SIZE", eng.decode_batch_size)
         )
@@ -146,6 +148,10 @@ class PodServerConfig:
         # Pipeline fused-decode bursts (host/device overlap); needs
         # DECODE_STEPS_PER_ITER > 1 to take effect.
         eng.decode_pipeline = _env_bool("DECODE_PIPELINE", "0")
+        # Speculative decoding ("off" | "prompt_lookup") + its knobs.
+        eng.spec_decode = os.environ.get("SPEC_DECODE", eng.spec_decode)
+        eng.spec_k = int(os.environ.get("SPEC_K", eng.spec_k))
+        eng.spec_ngram = int(os.environ.get("SPEC_NGRAM", eng.spec_ngram))
         # Weight quantization ("int8" halves weight HBM; models/quant.py).
         eng.quantize = os.environ.get("QUANTIZE") or None
         # CPU smoke runs (Pallas interpreter mode); never set on real TPU.

@@ -219,3 +219,54 @@ class TestPrefillIntegration:
 
         with pytest.raises(ValueError, match="prefill_attn"):
             Engine(EngineConfig(prefill_attn="cuda"))
+
+
+class TestMaskContract:
+    """prefill(attn_impl='pallas') requires a right-padded prefix mask; the
+    opt-in LLMD_CHECK_PREFILL_MASK host-callback assert catches violations
+    (the xla path honors arbitrary masks, so a holey mask would otherwise
+    silently diverge between the two implementations)."""
+
+    def _run(self, valid):
+        from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, llama
+
+        cfg = TINY_LLAMA
+        rng = np.random.default_rng(6)
+        b, s, page, total_pages = 2, 8, 4, 16
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        page_ids = jnp.asarray(
+            rng.permutation(total_pages - 1)[: b * (s // page)].reshape(b, -1),
+            jnp.int32,
+        ).repeat(page, axis=1)
+        slot_ids = jnp.broadcast_to(jnp.arange(s)[None, :] % page, (b, s))
+        bt = jnp.zeros((b, 2), jnp.int32)
+        cl = jnp.zeros((b,), jnp.int32)
+        kp, vp = llama.init_kv_pages(cfg, total_pages, page)
+        out = llama.prefill(
+            params, cfg, tokens, positions, jnp.asarray(valid), kp, vp,
+            page_ids, slot_ids, bt, cl, attn_impl="pallas",
+        )
+        jax.block_until_ready(out)
+
+    def test_check_passes_right_padded(self, monkeypatch):
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        monkeypatch.setenv("LLMD_CHECK_PREFILL_MASK", "1")
+        llama.prefill.clear_cache()  # env is read at trace time
+        valid = np.arange(8)[None, :] < np.asarray([8, 5])[:, None]
+        self._run(valid)  # must not raise
+        llama.prefill.clear_cache()
+
+    def test_check_rejects_interior_holes(self, monkeypatch):
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        monkeypatch.setenv("LLMD_CHECK_PREFILL_MASK", "1")
+        llama.prefill.clear_cache()
+        valid = np.arange(8)[None, :] < np.asarray([8, 5])[:, None]
+        valid = valid.copy()
+        valid[1, 2] = False  # hole inside the valid prefix
+        with pytest.raises(Exception, match="right-padded"):
+            self._run(valid)
+        llama.prefill.clear_cache()

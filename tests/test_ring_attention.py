@@ -81,3 +81,147 @@ class TestRingAttention:
         pert = np.asarray(ring_attention(q, k2, v2, mesh))
         np.testing.assert_allclose(pert[:, :24], base[:, :24], atol=2e-5)
         assert not np.allclose(pert[:, 24:], base[:, 24:])
+
+
+class TestSpPrefill:
+    """Sequence-parallel prefill: ring over the chunk + exact paged-context
+    merge (models/llama._sp_prefill_attention) must match the single-device
+    xla prefill bit-for-bit up to float associativity."""
+
+    def _setup(self, b=2, s=16, ctx_pages=2, page=4):
+        from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, init_params
+        from llm_d_kv_cache_manager_tpu.models import llama
+
+        cfg = TINY_LLAMA
+        rng = np.random.default_rng(11)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        total_pages = 32
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+        # Sequence 0 has prefix-cached context; sequence 1 is fresh.
+        ctx_lens = jnp.asarray([ctx_pages * page, 0], jnp.int32)
+        positions = ctx_lens[:, None] + jnp.arange(s)[None, :]
+        valid = jnp.arange(s)[None, :] < jnp.asarray([[s], [s - 4]])[:, 0, None]
+        page_ids = jnp.asarray(
+            rng.permutation(np.arange(1, total_pages))[: b * (s // page)]
+            .reshape(b, -1),
+            jnp.int32,
+        ).repeat(page, axis=1)
+        slot_ids = jnp.broadcast_to(jnp.arange(s)[None, :] % page, (b, s))
+        bt = jnp.zeros((b, ctx_pages), jnp.int32)
+        bt = bt.at[0].set(jnp.asarray([30, 31]))
+        kp, vp = llama.init_kv_pages(cfg, total_pages, page)
+        # Fill the context pages with realistic K/V.
+        kp = kp.at[:, 30:32].set(
+            jnp.asarray(
+                rng.normal(size=(cfg.n_layers, 2, page, cfg.n_kv_heads, cfg.hd))
+                * 0.3,
+                kp.dtype,
+            )
+        )
+        vp = vp.at[:, 30:32].set(
+            jnp.asarray(
+                rng.normal(size=(cfg.n_layers, 2, page, cfg.n_kv_heads, cfg.hd))
+                * 0.3,
+                vp.dtype,
+            )
+        )
+        return cfg, params, tokens, positions, valid, kp, vp, page_ids, slot_ids, bt, ctx_lens
+
+    def test_sp_prefill_matches_single_device(self):
+        from llm_d_kv_cache_manager_tpu.models import llama
+        from llm_d_kv_cache_manager_tpu.parallel import MeshConfig, make_mesh
+
+        (cfg, params, tokens, positions, valid, kp, vp,
+         page_ids, slot_ids, bt, ctx_lens) = self._setup()
+
+        kp2, vp2 = jnp.array(kp), jnp.array(vp)  # copies BEFORE donation
+        logits_ref, kp_ref, vp_ref = llama.prefill(
+            params, cfg, tokens, positions, valid, kp, vp,
+            page_ids, slot_ids, bt, ctx_lens, attn_impl="xla",
+        )
+        mesh = make_mesh(MeshConfig(dp=1, sp=4, tp=1))
+        logits_sp, kp_sp, vp_sp = llama.prefill(
+            params, cfg, tokens, positions, valid, kp2, vp2,
+            page_ids, slot_ids, bt, ctx_lens, mesh=mesh, attn_impl="xla",
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_sp), np.asarray(logits_ref), atol=2e-4, rtol=2e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(kp_sp), np.asarray(kp_ref), atol=1e-5, rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(vp_sp), np.asarray(vp_ref), atol=1e-5, rtol=1e-4
+        )
+
+    def test_sp_with_tp_composes(self):
+        from llm_d_kv_cache_manager_tpu.models import llama
+        from llm_d_kv_cache_manager_tpu.parallel import MeshConfig, make_mesh
+        from llm_d_kv_cache_manager_tpu.parallel.sharding import shard_params
+
+        (cfg, params, tokens, positions, valid, kp, vp,
+         page_ids, slot_ids, bt, ctx_lens) = self._setup()
+
+        kp2, vp2 = jnp.array(kp), jnp.array(vp)  # copies BEFORE donation
+        logits_ref, _, _ = llama.prefill(
+            params, cfg, tokens, positions, valid, kp, vp,
+            page_ids, slot_ids, bt, ctx_lens, attn_impl="xla",
+        )
+        mesh = make_mesh(MeshConfig(dp=1, sp=2, tp=2))
+        sharded = shard_params(params, mesh, cfg)
+        logits_sp, _, _ = llama.prefill(
+            sharded, cfg, tokens, positions, valid, kp2, vp2,
+            page_ids, slot_ids, bt, ctx_lens, mesh=mesh, attn_impl="xla",
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_sp), np.asarray(logits_ref), atol=2e-4, rtol=2e-3
+        )
+
+    def test_sp_indivisible_chunk_raises(self):
+        from llm_d_kv_cache_manager_tpu.models import llama
+        from llm_d_kv_cache_manager_tpu.parallel import MeshConfig, make_mesh
+
+        (cfg, params, tokens, positions, valid, kp, vp,
+         page_ids, slot_ids, bt, ctx_lens) = self._setup(s=16)
+        mesh = make_mesh(MeshConfig(dp=1, sp=3, tp=1))
+        with pytest.raises(ValueError, match="divisible by sp"):
+            llama.prefill(
+                params, cfg, tokens, positions, valid, kp, vp,
+                page_ids, slot_ids, bt, ctx_lens, mesh=mesh,
+            )
+
+
+class TestSpEngine:
+    """End-to-end: an sp=2 engine serves a prompt longer than one shard's
+    chunk and produces the same tokens as the single-device engine."""
+
+    def test_sp_engine_matches_single_device(self):
+        from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
+        from llm_d_kv_cache_manager_tpu.server import (
+            BlockManagerConfig,
+            Engine,
+            EngineConfig,
+            SamplingParams,
+        )
+
+        rng = np.random.default_rng(13)
+        prompt = list(rng.integers(0, TINY_LLAMA.vocab_size, 40))
+
+        def run(sp):
+            eng = Engine(
+                EngineConfig(
+                    model=TINY_LLAMA,
+                    block_manager=BlockManagerConfig(total_pages=64, page_size=4),
+                    max_model_len=64,
+                    decode_batch_size=2,
+                    prefill_bucket=8,
+                    sp=sp,
+                    interpret=True,
+                )
+            )
+            seq = eng.add_request(prompt, SamplingParams(max_new_tokens=5))
+            eng.run_until_complete()
+            assert seq.error is None
+            return seq.generated_tokens
+
+        assert run(1) == run(2)
