@@ -55,6 +55,7 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -296,20 +297,24 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     bus = LaggedEventBus(pool, lag_s)
     pods = [Pod(i, engine_cfg, params, publish, bus) for i in range(n_pods)]
     pod_names = [f"tpu-pod-{i}" for i in range(n_pods)]
-    est = None
-    if policy == "estimated":
+    est = aff = None
+    if policy in ("estimated", "precise"):
         ttl_env = os.environ.get("BENCH_EST_TTL_S", "")
         # Modeled capacity covers everything the pod can serve hits from:
         # HBM pages plus the host-DRAM tier when enabled (otherwise the
         # estimated baseline would be handicapped in exactly the
         # BENCH_HOST_PAGES tier-evidence runs).
-        est = EstimatedRouter(
+        router = EstimatedRouter(
             page,
             n_pods,
             capacity_blocks=engine_cfg.block_manager.total_pages
             + engine_cfg.block_manager.host_pages,
             ttl_s=float(ttl_env) if ttl_env else None,
         )
+        if policy == "estimated":
+            est = router
+        else:
+            aff = router  # precise's cold-index affinity tiebreak
 
     ttfts: dict[int, float] = {}
     arrivals: dict[int, float] = {}
@@ -325,10 +330,28 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
             # indexer would have by the arrival instant (publish + lag).
             bus.release(t)
             scores = indexer.score_tokens(tokens, MODEL_NAME, pod_names)
+            # Cold-index tiebreak: routed-affinity memory, not least load.
+            # Under pool thrash the index truthfully reports "cold
+            # everywhere", and pure load-tiebreaking scatters each prefix
+            # group across pods — measured WORSE than the index-free LRU
+            # comparator at a 1536-page pool (results/routing_capacity.md
+            # round 4; a load-blind static hash was worse still). The
+            # affinity memory gives load-aware FIRST placement, then keeps
+            # a group's rebuilds co-located so the index has warmth to
+            # report; real KV events still dominate whenever they exist.
+            # The reference's production scheduler blends its kv-cache
+            # scorer with prefix-affinity scorers for exactly this reason.
+            aff_keys = aff.keys(tokens)
             best = max(
                 range(n_pods),
-                key=lambda i: (scores.get(pod_names[i], 0), -pods[i].load, -i),
+                key=lambda i: (
+                    scores.get(pod_names[i], 0),
+                    aff.score(aff_keys, i, t),
+                    -pods[i].load,
+                    -i,
+                ),
             )
+            aff.record(aff_keys, best, t)
         elif policy == "estimated":
             keys = est.keys(tokens)
             best = max(
@@ -377,8 +400,6 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     # each policy's engines (~GBs of donated KV pools on the chip) survive
     # into the next policy until the cycle collector happens to run — which
     # OOMs the second policy on a 16 GB chip.
-    import gc
-
     pods.clear()
     gc.collect()
     return {
@@ -524,6 +545,7 @@ def main() -> int:
     jax.block_until_ready(params)
 
     warmup(params, engine_cfg, prefix_len, suffix_len, model_cfg.vocab_size, max_new)
+    gc.collect()  # scratch engine's KV pool must be gone before the fleet
 
     # Calibrate the arrival rate off the measured cold-request service time
     # so the middle of the QPS ramp saturates round-robin (its regime in
@@ -544,6 +566,7 @@ def main() -> int:
     cal_eng.run_until_complete()
     t_cold = (time.perf_counter() - t0) / batch_w  # per-request, batched cold
     del cal_eng  # release its KV pool before building the fleet
+    gc.collect()
     qps_mid = 1.4 * n_pods / max(t_cold, 1e-4)
     scales = [
         float(s)

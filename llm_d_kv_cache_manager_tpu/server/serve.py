@@ -76,6 +76,38 @@ class _ServingMetrics:
             registry=self.registry,
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30),
         )
+        # Speculative decoding (engine.spec_stats mirrored as counters;
+        # acceptance rate = accepted/proposed).
+        self.spec_proposed = prom.Counter(
+            "tpu_pod_spec_proposed_tokens_total",
+            "Speculative tokens proposed",
+            registry=self.registry,
+        )
+        self.spec_accepted = prom.Counter(
+            "tpu_pod_spec_accepted_tokens_total",
+            "Speculative tokens accepted",
+            registry=self.registry,
+        )
+        self.spec_verify = prom.Counter(
+            "tpu_pod_spec_verify_steps_total",
+            "Speculative verify dispatches",
+            registry=self.registry,
+        )
+        self._spec_seen = {"proposed": 0, "accepted": 0, "verify_steps": 0}
+
+    def sync_spec_stats(self, stats: dict) -> None:
+        """Mirror the engine's monotone spec counters into Prometheus."""
+        if self._prom is None:
+            return
+        for key, counter in (
+            ("proposed", self.spec_proposed),
+            ("accepted", self.spec_accepted),
+            ("verify_steps", self.spec_verify),
+        ):
+            delta = stats[key] - self._spec_seen[key]
+            if delta > 0:
+                counter.inc(delta)
+                self._spec_seen[key] = stats[key]
 
     def observe_finished(self, seq: Sequence) -> None:
         if self._prom is None:
@@ -261,6 +293,7 @@ class PodServer:
                     self._futures[seq.seq_id] = fut
                 if self.engine.has_work:
                     finished = self.engine.step()
+                    self.metrics.sync_spec_stats(self.engine.spec_stats)
                     for seq in finished:
                         self.metrics.observe_finished(seq)
                         fut = self._futures.pop(seq.seq_id, None)

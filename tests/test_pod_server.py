@@ -293,3 +293,18 @@ class TestHTTP:
             assert 0 <= data["free_pages"] <= 64
 
         self._run(scenario)
+
+
+def test_spec_stats_mirrored_to_prometheus():
+    prom = pytest.importorskip("prometheus_client")
+    del prom
+    from llm_d_kv_cache_manager_tpu.server.serve import _ServingMetrics
+
+    m = _ServingMetrics()
+    m.sync_spec_stats({"proposed": 4, "accepted": 1, "verify_steps": 2})
+    m.sync_spec_stats({"proposed": 10, "accepted": 7, "verify_steps": 5})
+    m.sync_spec_stats({"proposed": 10, "accepted": 7, "verify_steps": 5})  # no-op
+    text = m.exposition().decode()
+    assert "tpu_pod_spec_proposed_tokens_total 10.0" in text
+    assert "tpu_pod_spec_accepted_tokens_total 7.0" in text
+    assert "tpu_pod_spec_verify_steps_total 5.0" in text
