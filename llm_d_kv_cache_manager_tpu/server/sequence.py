@@ -54,6 +54,10 @@ class Sequence:
     finish_time: Optional[float] = None
     #: set when the engine had to abort the request (e.g. unschedulable)
     error: Optional[str] = None
+    #: speculative-decode acceptance history (drives the engine's adaptive
+    #: per-sequence gate; survives preemption with the sequence)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def __post_init__(self):
         if self.user_prompt_len < 0:

@@ -939,3 +939,22 @@ class TestSpeculativeDecode:
             _engine(spec_decode="medusa")
         with pytest.raises(ValueError, match="spec_k"):
             _engine(spec_decode="prompt_lookup", spec_k=0)
+
+    def test_spec_adaptive_gate_stops_hopeless_proposals(self):
+        # Force the gate shut by making acceptance impossible: propose from
+        # a seq whose output never echoes (random prompt) and verify the
+        # engine stops paying verify dispatches once the sample fills.
+        eng = _engine(
+            spec_decode="prompt_lookup", spec_k=4, spec_ngram=1,
+            spec_min_accept=1.1,  # nothing can satisfy this
+            spec_min_sample=4,
+        )
+        seq = eng.add_request(_prompt(60, 10), SamplingParams(max_new_tokens=24))
+        eng.run_until_complete()
+        assert len(seq.generated_tokens) == 24
+        stats = eng.spec_stats
+        # Gate must have ENGAGED, not been vacuously absent: proposals
+        # happened, then stopped shortly after the sample threshold — far
+        # below the no-gate worst case (~k per token).
+        assert stats["proposed"] >= eng.config.spec_min_sample
+        assert stats["proposed"] <= eng.config.spec_min_sample + eng.config.spec_k
