@@ -450,9 +450,9 @@ def init_params(
     created, so the full-precision tree is never resident — required to
     init 8B-class models on a single chip (16 GB bf16 + 8 GB int8 would
     not fit; see models/quant.py). MoE expert stacks stay in model dtype
-    unless ``quantize_experts=True`` (int8 experts measured slower — the
-    dequant doesn't fuse into ragged_dot, results/moe_dispatch.md — so
-    opt in only where HBM capacity forces it).
+    unless ``quantize_experts=True`` (opt-in; with the gmm kernel's
+    in-VMEM dequant int8 experts run ≈ bf16 speed while halving expert
+    HBM — results/moe_dispatch.md).
     """
     if quantize not in (None, "int8"):
         raise ValueError(f"unknown quantize mode {quantize!r}")

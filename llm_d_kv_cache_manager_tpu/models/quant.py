@@ -92,11 +92,12 @@ def quantize_params(
     by a :class:`QuantizedTensor`. Leaves everything else untouched.
 
     MoE expert stacks (3-D ``[E, in, out]`` weights) are SKIPPED by
-    default: measured on-chip, int8 experts lose — XLA fuses the dequant
-    into plain dots but not into ``ragged_dot``'s group-streamed operand,
-    so the full bf16 expert stack materializes per call (routed decode
-    2.5× slower; benchmarking/results/moe_dispatch.md). Opt in with
-    ``quantize_experts=True`` only where HBM capacity forces it.
+    default (conservative — expert numerics are routing-sensitive). With
+    ``quantize_experts=True`` they run through the Pallas grouped-matmul
+    kernel's in-VMEM dequant at ≈ bf16 speed while halving expert HBM
+    (round 4; benchmarking/results/moe_dispatch.md — the round-3 2.5×
+    ragged_dot penalty no longer applies when ``moe_gmm`` selects the
+    kernel, which is the TPU default).
     """
 
     def convert(d: dict) -> dict:

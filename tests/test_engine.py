@@ -958,3 +958,49 @@ class TestSpeculativeDecode:
         # below the no-gate worst case (~k per token).
         assert stats["proposed"] >= eng.config.spec_min_sample
         assert stats["proposed"] <= eng.config.spec_min_sample + eng.config.spec_k
+
+
+class TestDecodePathParityFuzz:
+    """Randomized cross-path parity: for random prompts/arrival patterns
+    and pool sizes, the four decode paths (plain, fused, pipelined, spec)
+    must produce IDENTICAL greedy token streams — the edges the targeted
+    tests don't enumerate (odd prompt lengths, mixed finish times, pool
+    sizes near the preemption boundary) get swept here."""
+
+    CONFIGS = [
+        dict(),  # plain
+        dict(decode_steps_per_iter=3),  # fused, odd burst
+        dict(decode_steps_per_iter=3, decode_pipeline=True),
+        dict(spec_decode="prompt_lookup", spec_k=3, spec_ngram=2),
+    ]
+
+    @pytest.mark.parametrize("seed", [101, 202, 303])
+    def test_paths_agree(self, seed):
+        rng = np.random.default_rng(seed)
+        n_req = int(rng.integers(2, 5))
+        prompts = []
+        for _ in range(n_req):
+            if rng.random() < 0.5:  # repetition-heavy (exercises spec)
+                pat = _prompt(int(rng.integers(0, 1000)), int(rng.integers(2, 5)))
+                prompts.append((pat * 6)[: int(rng.integers(8, 20))])
+            else:
+                prompts.append(_prompt(int(rng.integers(0, 1000)), int(rng.integers(5, 20))))
+        max_new = [int(rng.integers(3, 12)) for _ in range(n_req)]
+        pages = int(rng.integers(24, 64))
+        stagger = int(rng.integers(0, 3))
+
+        streams = []
+        for kw in self.CONFIGS:
+            eng = _engine(total_pages=pages, decode_batch=3, **kw)
+            seqs = []
+            for i, (p, m) in enumerate(zip(prompts, max_new)):
+                seqs.append(eng.add_request(p, SamplingParams(max_new_tokens=m)))
+                if stagger and i < n_req - 1:
+                    for _ in range(stagger):
+                        eng.step()
+            eng.run_until_complete()
+            assert all(s.error is None for s in seqs), kw
+            streams.append([s.generated_tokens for s in seqs])
+        for i, got in enumerate(streams[1:], 1):
+            assert got == streams[0], f"config {self.CONFIGS[i]} diverged (seed {seed})"
+        assert all(len(t) == m for t, m in zip(streams[0], max_new))
