@@ -97,17 +97,19 @@ def _sp_prefill_attention(
     """Sequence-parallel prefill attention: ring over the chunk, exact
     online-softmax merge with the paged prefix context.
 
-    The fresh chunk is sharded over the mesh's ``sp`` axis (contiguous
-    sequence shards). Each shard (a) runs a flash scan of its queries over
-    the paged context (pages replicated across sp; head-sharded across tp
-    exactly as in the tp paths), producing raw (m, l, acc) accumulators,
-    then (b) seeds the chunk ring with them
-    (``parallel/ring_attention.ring_attention_shard``) — K/V shards rotate
-    via ppermute (ICI-neighbor traffic only) and the merge is exact, so
-    the result matches the single-device online softmax over
-    [context ++ chunk] up to float associativity. Right-padded ``valid``
-    rides the ring as the key mask, so another shard's queries can never
-    attend a padded key.
+    The fresh chunk AND the paged context are both sharded over the
+    mesh's ``sp`` axis: shard *r* holds a contiguous chunk slice plus a
+    contiguous slice of the context block table, gathers only ITS context
+    pages (1/sp of the context HBM reads — replicating the gather per
+    shard was the first version's waste), and the ring rotates the
+    concatenated [ctx slice ++ chunk slice] K/V payload via ppermute
+    (ICI-neighbor traffic only). After sp rotations every query shard has
+    attended the full [context ++ chunk] key sequence with one exact
+    online-softmax accumulator, so the result matches the single-device
+    flash scan up to float associativity. Positions carry visibility:
+    context keys ride at position -1 (< any chunk q_pos), chunk keys at
+    their absolute positions; right-padded ``valid`` and the per-sequence
+    ``ctx_lens`` mask ride the ring as the key-validity lane.
 
     Removes the single-chip compute/activation ceiling on chunk length —
     the long-context serving path (SURVEY §5: sequence scaling lives in
@@ -115,35 +117,42 @@ def _sp_prefill_attention(
     """
     from jax.sharding import PartitionSpec as P
 
-    from ..ops.attention import FLASH_KEY_BLOCK, _flash_over_keys
     from ..parallel.mesh import shard_map_compat
     from ..parallel.ring_attention import ring_attention_shard
 
     has_tp = mesh.shape.get("tp", 1) > 1
+    sp = mesh.shape["sp"]
+    ctx_pages = block_tables.shape[1]
+    # Pad the block table so its page axis shards evenly (pad pages carry
+    # index 0 but sit beyond every ctx_len, so their keys are masked).
+    pad_pages = (-ctx_pages) % sp
+    if pad_pages:
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad_pages)))
 
     def body(q, k, v, positions, valid, kp, vp, bt, cl):
         b, s, n_q, d = q.shape
         n_kv = k.shape[2]
-        group = n_q // n_kv
         scale = d**-0.5
         pos = positions.astype(jnp.int32)
-        max_ctx = bt.shape[1] * kp.shape[1]
-        qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
-        if max_ctx:
-            ctx_k = jnp.moveaxis(kp[bt].reshape(b, max_ctx, n_kv, d), 1, 2)
-            ctx_v = jnp.moveaxis(vp[bt].reshape(b, max_ctx, n_kv, d), 1, 2)
-            ctx_valid = jnp.arange(max_ctx)[None, :] < cl[:, None]
-            # Context strictly precedes the chunk: position -1 < any q_pos.
-            ctx_pos = jnp.full((b, max_ctx), -1, jnp.int32)
-            init = _flash_over_keys(
-                qf, ctx_k, ctx_v, ctx_valid, ctx_pos, pos, scale,
-                FLASH_KEY_BLOCK, return_accumulators=True,
-            )
+        page_size = kp.shape[1]
+        my = jax.lax.axis_index("sp")
+        n_local = bt.shape[1] * page_size  # ctx tokens this shard gathered
+        if n_local:
+            ctx_k = kp[bt].reshape(b, n_local, n_kv, d)
+            ctx_v = vp[bt].reshape(b, n_local, n_kv, d)
+            # Global ctx token index of each local slot -> validity.
+            ctx_idx = my * n_local + jnp.arange(n_local)
+            ctx_valid = ctx_idx[None, :] < cl[:, None]
+            ctx_pos = jnp.full((b, n_local), -1, jnp.int32)
+            ring_k = jnp.concatenate([ctx_k, k], axis=1)
+            ring_v = jnp.concatenate([ctx_v, v], axis=1)
+            ring_pos = jnp.concatenate([ctx_pos, pos], axis=1)
+            ring_valid = jnp.concatenate([ctx_valid, valid], axis=1)
         else:
-            init = None
+            ring_k, ring_v, ring_pos, ring_valid = k, v, pos, valid
         return ring_attention_shard(
-            q, k, v, axis_name="sp", scale=scale, q_pos=pos,
-            k_valid=valid, init_state=init,
+            q, ring_k, ring_v, axis_name="sp", scale=scale, q_pos=pos,
+            k_pos=ring_pos, k_valid=ring_valid,
         )
 
     head = "tp" if has_tp else None
@@ -155,7 +164,7 @@ def _sp_prefill_attention(
         in_specs=(
             qkv_spec, qkv_spec, qkv_spec, seq_spec, seq_spec,
             P(None, None, head, None), P(None, None, head, None),
-            P(), P(),
+            P(None, "sp"), P(),
         ),
         out_specs=qkv_spec,
     )

@@ -77,6 +77,7 @@ def _flash_over_keys(
     scale: float,
     block: int,
     return_accumulators: bool = False,
+    init_state=None,
 ) -> jnp.ndarray:
     """Online-softmax (flash) attention over a virtual key sequence, scanned
     in key blocks so the [s, T] score matrix is never materialized — the
@@ -84,9 +85,11 @@ def _flash_over_keys(
     [s, block] is reused across scan iterations).
 
     With ``return_accumulators`` the raw flash state ``(m, l, acc)`` is
-    returned instead of the normalized output, so a caller can merge this
-    partial attention with another key range exactly (the sp-prefill path
-    merges paged-context accumulators into the chunk's ring)."""
+    returned instead of the normalized output, and ``init_state`` seeds
+    the scan from prior accumulators — together they let a caller chain
+    exact partial attentions over disjoint key ranges (the ring-attention
+    body scans each rotating payload this way, one blocked flash pass per
+    ring step)."""
     b, s, n_kv, group, d = qf.shape
     T = k_all.shape[2]
     # Short key sequences (cache-cold short prompts) shrink the block to a
@@ -105,9 +108,12 @@ def _flash_over_keys(
     valb = k_valid.reshape(b, n_blocks, block).transpose(1, 0, 2)
     posb = k_pos.reshape(b, n_blocks, block).transpose(1, 0, 2)
 
-    m0 = jnp.full((b, n_kv, group, s), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, n_kv, group, s), jnp.float32)
-    acc0 = jnp.zeros((b, n_kv, group, s, d), jnp.float32)
+    if init_state is None:
+        m0 = jnp.full((b, n_kv, group, s), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, n_kv, group, s), jnp.float32)
+        acc0 = jnp.zeros((b, n_kv, group, s, d), jnp.float32)
+    else:
+        m0, l0, acc0 = init_state
 
     def body(carry, blk):
         m, l, acc = carry

@@ -38,24 +38,29 @@ _NEG_INF = -1e30
 
 
 def _ring_body(carry, _, *, axis_name, qf, q_pos, scale, n_shards):
-    """One ring step: attend my query shard to the K/V shard currently held,
-    then pass that shard to the next device on the ring."""
+    """One ring step: attend my query shard to the K/V payload currently
+    held — as a BLOCKED flash scan (``ops.attention._flash_over_keys``
+    seeded with the carried accumulators), so the score tile stays
+    [s_q, key_block] however long the rotating payload is (the payload
+    carries context slices in the sp-prefill path; an unblocked
+    [s_q, s_k] tile would grow linearly with context and OOM exactly in
+    the long-context regime this path exists for) — then pass the
+    payload to the next device on the ring."""
+    from ..ops.attention import FLASH_KEY_BLOCK, _flash_over_keys
+
     k_cur, v_cur, kpos_cur, kvalid_cur, m, l, acc = carry
 
-    # [b, n_kv, g, s_q, s_k] score tile for this step.
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32)) * scale
-    mask = (
-        kvalid_cur[:, None, None, None, :]
-        & (kpos_cur[:, None, None, None, :] <= q_pos[:, None, None, :, None])
-    )
-    scores = jnp.where(mask, scores, _NEG_INF)
-
-    m_new = jnp.maximum(m, scores.max(axis=-1))
-    p = jnp.exp(scores - m_new[..., None]) * mask
-    corr = jnp.exp(m - m_new)
-    l_new = l * corr + p.sum(axis=-1)
-    acc_new = acc * corr[..., None] + jnp.einsum(
-        "bhgqk,bkhd->bhgqd", p, v_cur.astype(jnp.float32)
+    m_new, l_new, acc_new = _flash_over_keys(
+        qf,
+        jnp.moveaxis(k_cur, 1, 2),  # [b, s_k, n_kv, d] -> [b, n_kv, s_k, d]
+        jnp.moveaxis(v_cur, 1, 2),
+        kvalid_cur,
+        kpos_cur,
+        q_pos,
+        scale,
+        FLASH_KEY_BLOCK,
+        return_accumulators=True,
+        init_state=(m, l, acc),
     )
 
     # Rotate K/V/pos/validity to the next device; neighbor-only ICI traffic.
@@ -69,27 +74,30 @@ def _ring_body(carry, _, *, axis_name, qf, q_pos, scale, n_shards):
 
 def ring_attention_shard(
     q: jnp.ndarray,  # [b, s_shard, n_heads, d]
-    k: jnp.ndarray,  # [b, s_shard, n_kv_heads, d]
-    v: jnp.ndarray,  # [b, s_shard, n_kv_heads, d]
+    k: jnp.ndarray,  # [b, s_k_shard, n_kv_heads, d]
+    v: jnp.ndarray,  # [b, s_k_shard, n_kv_heads, d]
     *,
     axis_name: str = "sp",
     scale: Optional[float] = None,
     q_pos: Optional[jnp.ndarray] = None,  # [b, s_shard] absolute positions
-    k_valid: Optional[jnp.ndarray] = None,  # [b, s_shard] key padding mask
-    init_state: Optional[tuple] = None,  # (m, l, acc) seed — e.g. paged ctx
+    k_pos: Optional[jnp.ndarray] = None,  # [b, s_k_shard] key positions
+    k_valid: Optional[jnp.ndarray] = None,  # [b, s_k_shard] key padding mask
+    init_state: Optional[tuple] = None,  # (m, l, acc) seed for the flash state
 ) -> jnp.ndarray:
     """Per-shard ring attention body. Must run inside ``shard_map`` (or pmap)
-    over ``axis_name``; q/k/v are this device's sequence shard.
+    over ``axis_name``; q (and k/v) are this device's sequence shard.
 
     Defaults reproduce plain causal self-attention over the global
     sequence (positions derived from the shard index). The engine's
-    sp-prefill passes explicit ``q_pos`` (chunk tokens sit after a
-    prefix-cached context), a ``k_valid`` padding mask (right-padded
-    chunks), and ``init_state`` accumulators holding the paged-context
-    partial attention — the ring merge is exact, so the result equals a
+    sp-prefill passes a LONGER rotating key payload than the query shard
+    ([context slice ++ chunk slice], so ``k_pos``/``k_valid`` are
+    decoupled from ``q_pos``): context keys ride at position -1 (visible
+    to every chunk query), chunk keys at absolute positions, padding
+    masked — the ring merge is exact, so the result equals a
     single-device online softmax over [context ++ chunk].
     """
     b, s, n_q, d = q.shape
+    s_k = k.shape[1]
     n_kv = k.shape[2]
     group = n_q // n_kv
     if scale is None:
@@ -100,9 +108,12 @@ def ring_attention_shard(
     if q_pos is None:
         q_pos = (my * s + jnp.arange(s))[None, :].astype(jnp.int32)
         q_pos = jnp.broadcast_to(q_pos, (b, s))
-    k_pos = q_pos  # at step 0 each device holds its own K shard
+    if k_pos is None:
+        if s_k != s:
+            raise ValueError("k_pos required when k length differs from q")
+        k_pos = q_pos  # at step 0 each device holds its own K shard
     if k_valid is None:
-        k_valid = jnp.ones((b, s), bool)
+        k_valid = jnp.ones((b, s_k), bool)
 
     qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
     if init_state is None:
