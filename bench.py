@@ -226,58 +226,6 @@ def make_event_pipeline(index, n_pods):
     return pool, publish
 
 
-class EstimatedRouter:
-    """Prefix-affinity scorer WITHOUT the KV index (the reference's
-    "estimated" comparator — the llm-d scheduler's index-free prefix
-    scorer, which models each server's cache instead of observing it):
-    remembers which pod each token-block chain hash was routed to, using
-    the same TokenProcessor chunking the real indexer uses. Per pod the
-    memory is a capacity-bounded LRU (capacity = the pod's actual page
-    pool, in blocks) with optional TTL decay, so the model approximates
-    the pod's own LRU eviction rather than remembering forever — the
-    strongest index-free baseline. It still never sees KV events: real
-    evictions, preemptions and cross-policy cache state stay invisible,
-    which is precisely the gap `precise` closes."""
-
-    def __init__(self, page_size, n_pods, capacity_blocks, ttl_s=None):
-        from collections import OrderedDict
-
-        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
-            ChunkedTokenDatabase,
-            TokenProcessorConfig,
-        )
-
-        self.tp = ChunkedTokenDatabase(TokenProcessorConfig(block_size=page_size))
-        self.capacity = capacity_blocks
-        self.ttl_s = ttl_s
-        #: per-pod OrderedDict: block hash -> last-touch virtual time
-        self.routed = [OrderedDict() for _ in range(n_pods)]
-
-    def keys(self, tokens):
-        return self.tp.prefix_hashes(tokens)
-
-    def score(self, keys, pod, now):
-        lru = self.routed[pod]
-        n = 0
-        for h in keys:
-            ts = lru.get(h)
-            if ts is None or (self.ttl_s is not None and now - ts > self.ttl_s):
-                break
-            n += 1
-        return n
-
-    def record(self, keys, pod, now):
-        """Refresh the routed chain in the pod's modeled LRU (insertion
-        order = recency), then evict past capacity — mirroring what the
-        pod's own page pool will do with the blocks this request touches."""
-        lru = self.routed[pod]
-        for h in keys:
-            lru.pop(h, None)
-            lru[h] = now
-        while len(lru) > self.capacity:
-            lru.popitem(last=False)
-
-
 def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     """Run one routing policy over the workload; returns per-request and
     fleet-level metrics."""
@@ -297,24 +245,46 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     bus = LaggedEventBus(pool, lag_s)
     pods = [Pod(i, engine_cfg, params, publish, bus) for i in range(n_pods)]
     pod_names = [f"tpu-pod-{i}" for i in range(n_pods)]
+    blended = None
     est = aff = None
     if policy in ("estimated", "precise"):
+        from llm_d_kv_cache_manager_tpu.kvcache import PrefixAffinityTracker
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+            ChunkedTokenDatabase,
+            TokenProcessorConfig,
+        )
+
         ttl_env = os.environ.get("BENCH_EST_TTL_S", "")
-        # Modeled capacity covers everything the pod can serve hits from:
-        # HBM pages plus the host-DRAM tier when enabled (otherwise the
-        # estimated baseline would be handicapped in exactly the
-        # BENCH_HOST_PAGES tier-evidence runs).
-        router = EstimatedRouter(
-            page,
+        # The tracker IS product code (kvcache/router.py): as `estimated`
+        # it is the index-free comparator; as `aff` it is precise's
+        # cold-index tiebreak. Modeled capacity covers everything the pod
+        # can serve hits from: HBM pages plus the host-DRAM tier when
+        # enabled (otherwise the estimated baseline would be handicapped
+        # in exactly the BENCH_HOST_PAGES tier-evidence runs).
+        router = PrefixAffinityTracker(
             n_pods,
             capacity_blocks=engine_cfg.block_manager.total_pages
             + engine_cfg.block_manager.host_pages,
             ttl_s=float(ttl_env) if ttl_env else None,
+            token_processor=ChunkedTokenDatabase(
+                TokenProcessorConfig(block_size=page)
+            ),
         )
         if policy == "estimated":
             est = router
         else:
             aff = router  # precise's cold-index affinity tiebreak
+            from llm_d_kv_cache_manager_tpu.kvcache import BlendedRouter
+
+            blended = BlendedRouter(
+                score_fn=lambda toks, names: indexer.score_tokens(
+                    toks, MODEL_NAME, names
+                ),
+                affinity=aff,
+                loads_fn=lambda names: [
+                    pods[pod_names.index(nm)].load for nm in names
+                ],
+            )
 
     ttfts: dict[int, float] = {}
     arrivals: dict[int, float] = {}
@@ -327,31 +297,13 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
             pod.advance_to(t, ttfts, arrivals)
         if policy == "precise":
             # The index sees exactly the events a real deployment's
-            # indexer would have by the arrival instant (publish + lag).
+            # indexer would have by the arrival instant (publish + lag);
+            # routing is THE PRODUCT PATH (kvcache/router.BlendedRouter:
+            # index score → routed-affinity tiebreak → load — the blend
+            # that fixed the measured cold-index scatter under thrash,
+            # results/routing_capacity.md round 4).
             bus.release(t)
-            scores = indexer.score_tokens(tokens, MODEL_NAME, pod_names)
-            # Cold-index tiebreak: routed-affinity memory, not least load.
-            # Under pool thrash the index truthfully reports "cold
-            # everywhere", and pure load-tiebreaking scatters each prefix
-            # group across pods — measured WORSE than the index-free LRU
-            # comparator at a 1536-page pool (results/routing_capacity.md
-            # round 4; a load-blind static hash was worse still). The
-            # affinity memory gives load-aware FIRST placement, then keeps
-            # a group's rebuilds co-located so the index has warmth to
-            # report; real KV events still dominate whenever they exist.
-            # The reference's production scheduler blends its kv-cache
-            # scorer with prefix-affinity scorers for exactly this reason.
-            aff_keys = aff.keys(tokens)
-            best = max(
-                range(n_pods),
-                key=lambda i: (
-                    scores.get(pod_names[i], 0),
-                    aff.score(aff_keys, i, t),
-                    -pods[i].load,
-                    -i,
-                ),
-            )
-            aff.record(aff_keys, best, t)
+            best = pod_names.index(blended.route(tokens, pod_names, now=t).pod)
         elif policy == "estimated":
             keys = est.keys(tokens)
             best = max(

@@ -1,5 +1,6 @@
 from . import kvblock  # noqa: F401
 from .indexer import KVCacheIndexer, KVCacheIndexerConfig
+from .router import BlendedRouter, PrefixAffinityTracker, RoutingDecision
 from .scorer import (
     KVBlockScorer,
     KVBlockScorerConfig,
@@ -9,6 +10,9 @@ from .scorer import (
 )
 
 __all__ = [
+    "BlendedRouter",
+    "PrefixAffinityTracker",
+    "RoutingDecision",
     "kvblock",
     "KVCacheIndexer",
     "KVCacheIndexerConfig",
