@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test unit-test e2e-test demo bench bench-smoke bench-8b \
+.PHONY: all native test unit-test e2e-test demo bench bench-smoke bench-8b bench-pressure bench-lag10 \
         routing-bench engine-bench engine-bench-8b moe-bench poolsize-bench \
         kernel-parity dryrun docker lint
 
@@ -41,6 +41,15 @@ bench-smoke:
 ## 8B-at-north-star-scale variant (real Llama-3-8B, int8, 2-pod fleet).
 bench-8b:
 	BENCH_MODEL=8b-int8 BENCH_POLICIES=round_robin,precise $(PY) bench.py
+
+## Pool-pressure regime: precise (blended) vs the capacity-LRU comparator
+## at a thrash-sized pool — where eviction-awareness and affinity matter.
+bench-pressure:
+	BENCH_TOTAL_PAGES=1536 BENCH_POLICIES=precise,estimated $(PY) bench.py
+
+## Event-plane lag sweep endpoint (default lag is 2 ms; 0 = optimistic).
+bench-lag10:
+	BENCH_EVENT_LAG_MS=10 $(PY) bench.py
 
 routing-bench:
 	$(PY) benchmarking/bench_routing.py

@@ -85,6 +85,27 @@ def main() -> int:
         assert scores["tpu-pod-1"] == 1.0
         assert 0.0 < scores["tpu-pod-2"] < 1.0
         assert scores["tpu-pod-3"] == 0.0
+
+        # For schedulers that want the whole decision (not just one scorer
+        # in a blend), kvcache.BlendedRouter ships the measured-best blend:
+        # index score -> routed-affinity tiebreak -> load
+        # (benchmarking/results/routing_capacity.md round 4).
+        from llm_d_kv_cache_manager_tpu.kvcache import (
+            BlendedRouter,
+            PrefixAffinityTracker,
+        )
+
+        router = BlendedRouter(
+            score_fn=lambda toks, names: indexer.score_tokens(toks, model, names),
+            affinity=PrefixAffinityTracker(
+                len(pods), capacity_blocks=4096,
+                token_processor=indexer.token_processor,
+            ),
+            loads_fn=lambda names: [0.0] * len(names),  # wire real queue depths
+        )
+        decision = router.route([ord(c) for c in prompt], pods)
+        print(f"blended decision: {decision}")
+        assert decision.pod == "tpu-pod-1"  # warmest prefix wins
         print("OK")
         return 0
     finally:
