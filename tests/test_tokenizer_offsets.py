@@ -1,0 +1,100 @@
+"""Byte-offset conversion against the REAL Rust tokenizer core — offline.
+
+The network-gated suite (`test_real_tokenizer.py`) never runs in this
+image, which left the char→byte conversion's core assumption — that the
+HF `tokenizers` Python binding reports CHAR offsets (reference binding
+`pkg/tokenization/tokenizer.go:110-123` gets byte offsets from the same
+Rust core via cgo) — verified only by inspection. A handmade WordPiece
+vocab needs no network, so the real Rust encode path runs here:
+empirically, slicing the *string* with the binding's offsets yields the
+token surface forms while slicing the UTF-8 *bytes* yields garbage —
+char offsets, as assumed.
+"""
+
+import pytest
+
+tokenizers = pytest.importorskip("tokenizers")
+
+from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+    CachedHFTokenizer,
+    HFTokenizerConfig,
+    char_offsets_to_byte_offsets,
+)
+
+PROMPT = "café 中文 hi 🚀 x"
+
+
+def _rust_tokenizer():
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {
+        "[UNK]": 0, "caf": 1, "##é": 2, "é": 3, "x": 4,
+        "中": 5, "##文": 6, "hi": 7, "🚀": 8,
+    }
+    tok = Tokenizer(models.WordPiece(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    return tok
+
+
+def test_rust_binding_reports_char_offsets():
+    # The load-bearing assumption, verified against the actual Rust core:
+    # offsets index CHARS (str slices reproduce token surfaces)...
+    enc = _rust_tokenizer().encode(PROMPT)
+    surfaces = [PROMPT[lo:hi] for lo, hi in enc.offsets]
+    assert surfaces == ["caf", "é", "中", "文", "hi", "🚀", "x"]
+    # ...and NOT bytes (byte slices diverge as soon as multi-byte chars
+    # appear — if this ever starts passing, the binding changed semantics
+    # and char_offsets_to_byte_offsets must be retired).
+    data = PROMPT.encode("utf-8")
+    byte_surfaces = [data[lo:hi] for lo, hi in enc.offsets]
+    assert byte_surfaces != [s.encode() for s in surfaces]
+
+
+def test_conversion_yields_correct_byte_slices():
+    enc = _rust_tokenizer().encode(PROMPT)
+    data = PROMPT.encode("utf-8")
+    byte_offsets = char_offsets_to_byte_offsets(PROMPT, enc.offsets)
+    assert [data[lo:hi].decode("utf-8") for lo, hi in byte_offsets] == [
+        "caf", "é", "中", "文", "hi", "🚀", "x"
+    ]
+    # Monotone, in-range, and the reference contract's shape (lo <= hi).
+    last = 0
+    for lo, hi in byte_offsets:
+        assert 0 <= lo <= hi <= len(data)
+        assert lo >= last
+        last = hi
+
+
+def test_cached_tokenizer_end_to_end_with_rust_core(monkeypatch):
+    tok = CachedHFTokenizer(HFTokenizerConfig())
+    monkeypatch.setattr(tok, "_load", lambda model_name: _rust_tokenizer())
+    ids, offsets = tok.encode(PROMPT, "handmade/wordpiece")
+    assert ids == [1, 2, 5, 6, 7, 8, 4]
+    data = PROMPT.encode("utf-8")
+    assert data[offsets[1][0] : offsets[1][1]].decode() == "é"
+    assert data[offsets[5][0] : offsets[5][1]].decode() == "🚀"
+    # Cached: second encode must not reload.
+    calls = []
+    monkeypatch.setattr(
+        tok, "_load", lambda model_name: calls.append(model_name)
+    )
+    ids2, _ = tok.encode(PROMPT, "handmade/wordpiece")
+    assert ids2 == ids and calls == []
+
+
+def test_prefix_store_roundtrip_with_real_offsets():
+    from llm_d_kv_cache_manager_tpu.tokenization.prefixstore import (
+        Config,
+        LRUTokenStore,
+    )
+
+    prompt = ("café 中文 hi 🚀 x " * 6).strip()
+    tok = _rust_tokenizer()
+    enc = tok.encode(prompt)
+    byte_offsets = char_offsets_to_byte_offsets(prompt, enc.offsets)
+    store = LRUTokenStore(Config(block_size=8))
+    store.add_tokenization("m", prompt, list(enc.ids), byte_offsets)
+    contained, ratio = store.find_longest_contained_tokens(prompt, "m")
+    assert ratio > 0.8
+    assert contained == list(enc.ids)[: len(contained)]
+    assert len(contained) >= 0.7 * len(enc.ids)
