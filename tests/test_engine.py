@@ -972,6 +972,8 @@ class TestDecodePathParityFuzz:
         dict(decode_steps_per_iter=3),  # fused, odd burst
         dict(decode_steps_per_iter=3, decode_pipeline=True),
         dict(spec_decode="prompt_lookup", spec_k=3, spec_ngram=2),
+        dict(host_pages=16),  # host-DRAM offload tier in the loop
+        dict(sp=2),  # sequence-parallel prefill on the virtual mesh
     ]
 
     @pytest.mark.parametrize("seed", [101, 202, 303])
