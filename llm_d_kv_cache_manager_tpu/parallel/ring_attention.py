@@ -14,8 +14,9 @@ as ``ops.attention._flash_over_keys``): each ring step contributes a partial
 single-device flash attention up to float-associativity.
 
 Layout notes (TPU-first):
-- Q/K/V stay ``[b, s/n, heads, d]`` per shard; einsums keep the contraction
-  shapes MXU-friendly ([s/n, s/n] score tiles per step).
+- Q/K/V stay ``[b, s/n, heads, d]`` per shard; each ring step runs a
+  BLOCKED flash scan over the held payload, so score tiles stay
+  ``[s/n, key_block]`` regardless of payload length.
 - The rotation count is static (mesh size), so the whole ring unrolls inside
   one jit: XLA overlaps each step's ppermute with the previous step's
   compute (double-buffered collective-permute).
@@ -32,7 +33,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = -1e30
 
