@@ -2,7 +2,13 @@
 
 One fused function over the batch — sampling params are per-sequence arrays
 so mixed strategies share a single compiled program (no per-request
-recompiles, XLA-friendly static shapes).
+recompiles, XLA-friendly static shapes). ``spec_sample`` extends the same
+filtered distributions to speculative-decode verification with
+DETERMINISTIC drafts (prompt-lookup proposals): accept draft ``d`` with
+probability ``P(d)``; on rejection sample from the residual ``P`` with
+``d`` removed (for a delta-function proposal the standard
+speculative-sampling residual ``(p - q)_+`` is exactly that) — the emitted
+stream is an exact sample of the target distribution per position.
 """
 
 from __future__ import annotations
@@ -13,24 +19,21 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=())
-def sample_tokens(
-    logits: jnp.ndarray,  # [batch, vocab] f32
-    temperature: jnp.ndarray,  # [batch] f32; 0 = greedy
-    top_k: jnp.ndarray,  # [batch] int32; 0 = disabled
-    top_p: jnp.ndarray,  # [batch] f32; 1 = disabled
-    rng_key: jax.Array,
+def _filtered_logits(
+    logits: jnp.ndarray,  # [rows, vocab] f32
+    temperature: jnp.ndarray,  # [rows] f32; 0 = greedy (filter inert)
+    top_k: jnp.ndarray,  # [rows] int32; 0 = disabled
+    top_p: jnp.ndarray,  # [rows] f32; 1 = disabled
 ) -> jnp.ndarray:
-    """Returns sampled token ids [batch] int32."""
+    """Temperature-scaled logits with top-k/top-p masking (-inf off-support)."""
     vocab = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # Temperature scaling (guard 0 for the greedy lanes).
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
 
     # Top-k mask: keep the k highest logits per row.
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [b, vocab]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [rows, vocab]
     k = jnp.where(top_k > 0, top_k, vocab).astype(jnp.int32)
     kth_val = jnp.take_along_axis(
         sorted_desc, jnp.clip(k - 1, 0, vocab - 1)[:, None], axis=-1
@@ -46,7 +49,78 @@ def sample_tokens(
     threshold = jnp.min(
         jnp.where(cutoff_mask, sorted_masked, jnp.inf), axis=-1, keepdims=True
     )
-    masked = jnp.where(masked >= threshold, masked, -jnp.inf)
+    return jnp.where(masked >= threshold, masked, -jnp.inf)
 
+
+@functools.partial(jax.jit, static_argnames=())
+def sample_tokens(
+    logits: jnp.ndarray,  # [batch, vocab] f32
+    temperature: jnp.ndarray,  # [batch] f32; 0 = greedy
+    top_k: jnp.ndarray,  # [batch] int32; 0 = disabled
+    top_p: jnp.ndarray,  # [batch] f32; 1 = disabled
+    rng_key: jax.Array,
+) -> jnp.ndarray:
+    """Returns sampled token ids [batch] int32."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = _filtered_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng_key, masked, axis=-1).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def spec_sample(
+    logits: jnp.ndarray,  # [batch, s, vocab] f32 — verify logits per position
+    drafts: jnp.ndarray,  # [batch, s] int32 — proposed token per position
+    temperature: jnp.ndarray,  # [batch] f32; 0 = greedy
+    top_k: jnp.ndarray,  # [batch] int32
+    top_p: jnp.ndarray,  # [batch] f32
+    rng_key: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative verification for deterministic drafts.
+
+    Per position ``j`` with filtered target distribution ``P_j``:
+
+    - ``accept[b, j]``: draft accepted — sampled lanes with probability
+      ``P_j(draft)``, greedy lanes iff ``draft == argmax``;
+    - ``replacement[b, j]``: the token to emit at the FIRST rejection —
+      sampled from ``P_j`` with the draft removed and renormalized (the
+      ``(p - q)_+`` residual for a delta proposal; never equals the
+      draft), greedy lanes the plain argmax;
+    - ``free[b, j]``: an unconditioned sample from ``P_j`` — used for the
+      bonus position after all drafts accept (and for empty-proposal
+      lanes, where position 0 is a plain decode sample).
+
+    The host walks accept[] to the first False per lane; everything after
+    is discarded (those positions were scored under a rejected context).
+    """
+    b, s, vocab = logits.shape
+    flat = logits.reshape(b * s, vocab)
+    rep = lambda x: jnp.repeat(x, s)
+    masked = _filtered_logits(flat, rep(temperature), rep(top_k), rep(top_p))
+    greedy = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    d = drafts.reshape(-1).astype(jnp.int32)
+
+    probs = jax.nn.softmax(masked, axis=-1)
+    p_draft = jnp.take_along_axis(probs, d[:, None], axis=-1)[:, 0]
+
+    k_u, k_repl, k_free = jax.random.split(rng_key, 3)
+    u = jax.random.uniform(k_u, (b * s,))
+    sampled_accept = u < p_draft
+    accept = jnp.where(rep(temperature) > 0, sampled_accept, d == greedy)
+
+    draft_hot = jax.nn.one_hot(d, vocab, dtype=bool)
+    masked_no_draft = jnp.where(draft_hot, -jnp.inf, masked)
+    repl_sampled = jax.random.categorical(k_repl, masked_no_draft, axis=-1)
+    replacement = jnp.where(
+        rep(temperature) > 0, repl_sampled, greedy
+    ).astype(jnp.int32)
+
+    free_sampled = jax.random.categorical(k_free, masked, axis=-1)
+    free = jnp.where(rep(temperature) > 0, free_sampled, greedy).astype(
+        jnp.int32
+    )
+    return (
+        accept.reshape(b, s),
+        replacement.reshape(b, s),
+        free.reshape(b, s),
+    )

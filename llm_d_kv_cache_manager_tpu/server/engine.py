@@ -29,7 +29,7 @@ from ..models import llama
 from ..models.llama import LlamaConfig
 from ..utils import get_logger
 from .block_manager import AllocationError, BlockManager, BlockManagerConfig
-from ..ops.sampling import sample_tokens
+from ..ops.sampling import sample_tokens, spec_sample
 from .scheduler import Scheduler, SchedulerConfig
 from .sequence import SamplingParams, Sequence, SequenceStatus
 
@@ -111,9 +111,10 @@ class EngineConfig:
     #: propose the continuation of the context's own last n-gram from an
     #: earlier occurrence; accept via one verify dispatch that scores all
     #: k+1 tokens — exactly a warm prefill over [context ++ proposals]).
-    #: Applies to batches where every lane is greedy (temperature 0);
-    #: sampled batches fall back to the normal decode path (spec sampling
-    #: for temperature>0 is not implemented).
+    #: Greedy lanes accept iff draft == argmax; temperature>0 lanes run
+    #: deterministic-draft speculative SAMPLING (accept with prob
+    #: P(draft), residual sample on rejection — exact for each lane's
+    #: filtered distribution; ops/sampling.spec_sample).
     spec_decode: str = "off"
     #: proposed tokens per verify step (accepted 0..k, +1 corrected/bonus
     #: token always emitted — a spec step never yields fewer tokens than a
@@ -499,9 +500,7 @@ class Engine:
         return min(self.max_pages_per_seq, _round_up(used, bucket))
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
-        if self.config.spec_decode == "prompt_lookup" and all(
-            s.sampling.temperature == 0 for s in seqs
-        ):
+        if self.config.spec_decode == "prompt_lookup":
             # Commit lag: the drain can finish lanes — never reserve for or
             # dispatch a finished sequence (same rule as the fused path).
             self._drain_inflight()
@@ -752,21 +751,27 @@ class Engine:
         the last committed token plus up to ``spec_k`` proposed tokens —
         exactly a warm prefill over [paged context ++ chunk] (the chunk is
         [t_last, d_1..d_m], positions from num_tokens-1, context =
-        num_tokens-1 committed tokens) with full-position logits. The
-        longest proposal prefix matching the model's own greedy choices is
-        accepted, plus the model's token at the first mismatch (or a bonus
-        token when everything matched) — so a step emits 1..k+1 tokens and
-        never fewer than plain decode. Returns False (nothing dispatched)
-        when every lane's proposal is empty; the caller then runs the
-        cheaper plain/fused step.
+        num_tokens-1 committed tokens) with full-position logits. Greedy
+        lanes accept the longest proposal prefix matching the model's own
+        argmax, plus the argmax at the first mismatch (or a bonus token
+        when everything matched); temperature>0 lanes run
+        deterministic-draft speculative SAMPLING via
+        ``ops/sampling.spec_sample`` (accept draft with prob P(draft);
+        residual sample on rejection; unconditioned bonus) — exact for
+        each lane's filtered distribution. Either way a step emits
+        1..k+1 tokens and never fewer than plain decode. Returns False
+        (nothing dispatched) when every lane's proposal is empty; the
+        caller then runs the cheaper plain/fused step.
 
-        Emitted tokens are the model's own greedy choices as scored by the
+        Greedy emitted tokens are the model's choices as scored by the
         PREFILL path; in interpret/XLA numerics that is bit-identical to
         plain greedy decode (the parity the tests pin). On-chip, verify
         (flash-prefill kernel) and plain decode (paged-attention kernel)
         reduce in different orders, so a near-tie can resolve differently
-        — outputs remain exact greedy samples of the verify logits, but
-        cross-path bit-equality is not guaranteed on TPU.
+        — outputs remain exact samples of the verify logits, but
+        cross-path bit-equality is not guaranteed on TPU. Sampled lanes
+        consume the engine rng differently from plain decode (identically
+        DISTRIBUTED, not bit-identical — the pipelined-burst caveat).
 
         Rejected drafts leave stale K/V in slots the sequence already owns
         beyond ``num_computed``; nothing ever attends past ``seq_len`` and
@@ -842,24 +847,63 @@ class Engine:
             attn_impl=self.prefill_attn,
             return_all_logits=True,
         )
-        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [b, s_chunk]
+        # Verification: greedy lanes accept iff draft == argmax; sampled
+        # lanes run deterministic-draft speculative sampling (accept with
+        # prob P(draft), residual sample on rejection) — exact for each
+        # lane's filtered distribution (ops/sampling.spec_sample).
+        temperature = np.zeros((b,), np.float32)
+        top_k_arr = np.zeros((b,), np.int32)
+        top_p_arr = np.ones((b,), np.float32)
+        for i, seq in enumerate(active):
+            temperature[i] = seq.sampling.temperature
+            top_k_arr[i] = seq.sampling.top_k
+            top_p_arr[i] = seq.sampling.top_p
+        # Position alignment: logits[j] predict the token AFTER chunk[j];
+        # the draft under test there is chunk[j+1], so drafts shift left.
+        # The trailing slot has no draft and is only ever read by `free`
+        # (which ignores the draft).
+        drafts = np.zeros((b, s_chunk), np.int32)
+        drafts[:, :-1] = tokens[:, 1:]
+        if not (temperature > 0).any():
+            # All-greedy fast path (the common spec workload): one argmax,
+            # one transfer — no filtered-distribution sorts, no categorical
+            # draws, and the engine rng is left untouched.
+            greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [b, s_chunk]
+            accept = greedy == drafts
+            replacement = greedy
+            free = greedy
+        else:
+            self._rng, key = jax.random.split(self._rng)
+            accept_d, replacement_d, free_d = spec_sample(
+                logits,
+                jnp.asarray(drafts),
+                jnp.asarray(temperature),
+                jnp.asarray(top_k_arr),
+                jnp.asarray(top_p_arr),
+                key,
+            )
+            accept = np.asarray(accept_d)
+            replacement = np.asarray(replacement_d)
+            free = np.asarray(free_d)
 
         self.spec_stats["verify_steps"] += 1
         for i, (seq, prop) in enumerate(zip(active, proposals)):
             if not seq.block_table:
                 continue  # preempted by a batchmate's reservation
             accepted = 0
-            while accepted < len(prop) and prop[accepted] == int(
-                greedy[i, accepted]
-            ):
+            while accepted < len(prop) and bool(accept[i, accepted]):
                 accepted += 1
             self.spec_stats["proposed"] += len(prop)
             self.spec_stats["accepted"] += accepted
             seq.spec_proposed += len(prop)
             seq.spec_accepted += accepted
-            # Accepted drafts + the model's token at the first mismatch
-            # (bonus token when every draft matched).
-            emit = prop[:accepted] + [int(greedy[i, accepted])]
+            # Accepted drafts + the replacement at the first rejection
+            # (or an unconditioned bonus sample when every draft matched).
+            if accepted < len(prop):
+                corrected = int(replacement[i, accepted])
+            else:
+                corrected = int(free[i, accepted])
+            emit = prop[:accepted] + [corrected]
             for tok in emit:
                 if self._should_finish(seq):
                     break

@@ -909,15 +909,49 @@ class TestSpeculativeDecode:
         # a pure cycle greedy almost always repeats; keep a soft floor.
         assert eng.spec_stats["proposed"] >= 0
 
-    def test_spec_sampled_batch_falls_back(self):
-        eng = _engine(spec_decode="prompt_lookup", spec_k=4)
+    def test_spec_sampled_lane_generates(self):
+        # temperature>0 runs deterministic-draft speculative sampling; the
+        # request completes with the right count and in-vocab tokens.
+        cyc = _prompt(55, 2) * 8
+        eng = _engine(spec_decode="prompt_lookup", spec_k=4, spec_ngram=2)
         seq = eng.add_request(
-            _prompt(55, 9),
-            SamplingParams(max_new_tokens=5, temperature=0.8, top_k=8),
+            cyc, SamplingParams(max_new_tokens=9, temperature=0.8, top_k=8)
         )
         eng.run_until_complete()
-        assert len(seq.generated_tokens) == 5
-        assert eng.spec_stats["verify_steps"] == 0  # spec never engaged
+        assert len(seq.generated_tokens) == 9
+        assert all(0 <= t < TINY_LLAMA.vocab_size for t in seq.generated_tokens)
+
+    def test_spec_topk1_sampling_equals_greedy(self):
+        # top_k=1 collapses every filtered distribution to a point mass, so
+        # temperature>0 spec sampling must emit EXACTLY the greedy stream —
+        # a deterministic end-to-end check of the acceptance/residual math.
+        cyc = _prompt(57, 3) * 6
+        outs = []
+        for sampling in (
+            SamplingParams(max_new_tokens=10),
+            SamplingParams(max_new_tokens=10, temperature=0.9, top_k=1),
+        ):
+            eng = _engine(spec_decode="prompt_lookup", spec_k=4, spec_ngram=2)
+            seq = eng.add_request(list(cyc), sampling)
+            eng.run_until_complete()
+            outs.append(seq.generated_tokens)
+        assert outs[0] == outs[1]
+
+    def test_spec_mixed_greedy_and_sampled_batch(self):
+        eng = _engine(spec_decode="prompt_lookup", spec_k=3, spec_ngram=2)
+        g = eng.add_request(_prompt(58, 2) * 6, SamplingParams(max_new_tokens=7))
+        s = eng.add_request(
+            _prompt(59, 9),
+            SamplingParams(max_new_tokens=7, temperature=0.7, top_p=0.9),
+        )
+        eng.run_until_complete()
+        assert len(g.generated_tokens) == 7 and len(s.generated_tokens) == 7
+        # The greedy lane must match a spec engine run without the sampled
+        # batchmate (per-lane independence).
+        eng2 = _engine(spec_decode="prompt_lookup", spec_k=3, spec_ngram=2)
+        g2 = eng2.add_request(_prompt(58, 2) * 6, SamplingParams(max_new_tokens=7))
+        eng2.run_until_complete()
+        assert g.generated_tokens == g2.generated_tokens
 
     def test_spec_under_pool_pressure(self):
         def drive(eng):

@@ -1,0 +1,117 @@
+"""Sampling ops: filtered distributions and speculative verification.
+
+`spec_sample` implements deterministic-draft speculative sampling (accept
+draft with prob P(draft); residual sample on rejection) — the invariants
+below are what make the emitted stream an exact sample of the target
+distribution, so they are pinned as pure-function tests.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from llm_d_kv_cache_manager_tpu.ops.sampling import sample_tokens, spec_sample
+
+V = 16
+
+
+def _logits(rng, b, s):
+    return jnp.asarray(rng.standard_normal((b, s, V)) * 3.0, jnp.float32)
+
+
+class TestSpecSample:
+    def test_greedy_lanes_match_argmax_semantics(self):
+        rng = np.random.default_rng(0)
+        logits = _logits(rng, 2, 4)
+        argmax = np.asarray(jnp.argmax(logits, -1))
+        drafts = jnp.asarray(argmax.copy())
+        drafts = drafts.at[0, 2].set((argmax[0, 2] + 1) % V)  # one mismatch
+        accept, replacement, free = spec_sample(
+            logits, drafts,
+            jnp.zeros((2,)), jnp.zeros((2,), jnp.int32), jnp.ones((2,)),
+            jax.random.PRNGKey(0),
+        )
+        accept = np.asarray(accept)
+        assert accept[1].all() and accept[0, [0, 1, 3]].all()
+        assert not accept[0, 2]
+        np.testing.assert_array_equal(np.asarray(replacement), argmax)
+        np.testing.assert_array_equal(np.asarray(free), argmax)
+
+    def test_replacement_never_equals_draft_for_sampled_lanes(self):
+        rng = np.random.default_rng(1)
+        logits = _logits(rng, 3, 5)
+        drafts = jnp.asarray(rng.integers(0, V, (3, 5)), jnp.int32)
+        for seed in range(5):
+            _, replacement, _ = spec_sample(
+                logits, drafts,
+                jnp.full((3,), 1.0), jnp.zeros((3,), jnp.int32), jnp.ones((3,)),
+                jax.random.PRNGKey(seed),
+            )
+            assert not np.any(np.asarray(replacement) == np.asarray(drafts))
+
+    def test_topk1_collapses_to_argmax(self):
+        # A point-mass distribution: accept iff draft == argmax; free is
+        # argmax; so temperature>0 behaves exactly like greedy.
+        rng = np.random.default_rng(2)
+        logits = _logits(rng, 2, 4)
+        argmax = np.asarray(jnp.argmax(logits, -1))
+        drafts = jnp.asarray(argmax)
+        accept, _, free = spec_sample(
+            logits, drafts,
+            jnp.full((2,), 0.8), jnp.ones((2,), jnp.int32), jnp.ones((2,)),
+            jax.random.PRNGKey(3),
+        )
+        assert np.asarray(accept).all()
+        np.testing.assert_array_equal(np.asarray(free), argmax)
+
+    def test_acceptance_rate_tracks_draft_probability(self):
+        # Statistical: with temperature 1 and a known distribution, the
+        # measured acceptance over many keys approaches P(draft).
+        logits = jnp.log(
+            jnp.asarray([[[0.7, 0.2, 0.1] + [1e-9] * (V - 3)]], jnp.float32)
+        )
+        drafts = jnp.zeros((1, 1), jnp.int32)  # P(draft) = 0.7
+        hits = 0
+        n = 400
+        for seed in range(n):
+            accept, _, _ = spec_sample(
+                logits, drafts,
+                jnp.ones((1,)), jnp.zeros((1,), jnp.int32), jnp.ones((1,)),
+                jax.random.PRNGKey(seed),
+            )
+            hits += int(np.asarray(accept)[0, 0])
+        assert 0.6 < hits / n < 0.8  # ~±4 sigma band around 0.7
+
+    def test_free_samples_stay_in_topk_support(self):
+        rng = np.random.default_rng(4)
+        logits = _logits(rng, 2, 3)
+        top2 = np.asarray(jnp.argsort(logits, -1))[:, :, -2:]
+        drafts = jnp.zeros((2, 3), jnp.int32)
+        for seed in range(5):
+            _, _, free = spec_sample(
+                logits, drafts,
+                jnp.full((2,), 1.0), jnp.full((2,), 2, jnp.int32), jnp.ones((2,)),
+                jax.random.PRNGKey(seed),
+            )
+            f = np.asarray(free)
+            for bi in range(2):
+                for si in range(3):
+                    assert f[bi, si] in top2[bi, si]
+
+
+class TestSampleTokensStillIntact:
+    def test_greedy_and_sampled(self):
+        rng = np.random.default_rng(5)
+        logits = jnp.asarray(rng.standard_normal((4, V)) * 3, jnp.float32)
+        toks = sample_tokens(
+            logits,
+            jnp.asarray([0.0, 0.0, 1.0, 1.0]),
+            jnp.asarray([0, 0, 2, 0], jnp.int32),
+            jnp.asarray([1.0, 1.0, 1.0, 0.9]),
+            jax.random.PRNGKey(0),
+        )
+        toks = np.asarray(toks)
+        argmax = np.asarray(jnp.argmax(logits, -1))
+        assert toks[0] == argmax[0] and toks[1] == argmax[1]
+        assert all(0 <= t < V for t in toks)
