@@ -1008,6 +1008,17 @@ class TestDecodePathParityFuzz:
         dict(spec_decode="prompt_lookup", spec_k=3, spec_ngram=2),
         dict(host_pages=16),  # host-DRAM offload tier in the loop
         dict(sp=2),  # sequence-parallel prefill on the virtual mesh
+        # interaction: spec verify dispatches through an sp-sharded prefill
+        dict(sp=2, spec_decode="prompt_lookup", spec_k=3, spec_ngram=2),
+        # interaction: spec's empty-proposal fallback lands in the
+        # PIPELINED fused path (drain-before-spec + chained bursts)
+        dict(
+            decode_steps_per_iter=3,
+            decode_pipeline=True,
+            spec_decode="prompt_lookup",
+            spec_k=3,
+            spec_ngram=2,
+        ),
     ]
 
     @pytest.mark.parametrize("seed", [101, 202, 303])
