@@ -57,10 +57,20 @@ def _round8(m: int) -> int:
 
 def _divisor_tile(dim: int, cap: int) -> int:
     """Largest lane-aligned tile <= cap that divides ``dim`` exactly (the
-    int8 kernel skips remainder-tile masking); falls back to ``dim``."""
-    for t in range(min(cap, dim), 127, -128):
-        if dim % t == 0:
-            return t
+    kernels skip remainder-tile masking). A dim with no such divisor runs
+    as ONE full-width tile — fine for small (tiny-test) geometries, but a
+    LARGE unaligned dim would silently blow VMEM with no pointer at the
+    cause, so that case fails loudly instead."""
+    if dim % 128 == 0:
+        for t in range(min(cap, dim), 127, -128):
+            if dim % t == 0:
+                return t
+    if dim > cap:
+        raise ValueError(
+            f"gmm kernel tiling: dim {dim} is not 128-aligned and exceeds "
+            f"the tile cap {cap} (a full-width tile would exhaust VMEM); "
+            "use moe_gmm='xla' (ragged_dot) for this geometry"
+        )
     return dim
 
 

@@ -727,7 +727,17 @@ class Engine:
         extractive/structured generations where the output echoes the
         prompt). Host-side, O(spec_max_scan)."""
         n = self.config.spec_ngram
-        k = self.config.spec_k
+        # Clamp to the remaining token budget: drafts past max_new_tokens-1
+        # (the verify emits accepted+1) or max_model_len-1 can never be
+        # emitted — scoring them would reserve pages and KV-write positions
+        # past the effective cap for nothing under pool pressure.
+        k = min(
+            self.config.spec_k,
+            seq.sampling.max_new_tokens - seq.num_generated - 1,
+            self.config.max_model_len - seq.num_tokens - 1,
+        )
+        if k < 1:
+            return []
         toks = seq.all_tokens
         if len(toks) < n + 1:
             return []
@@ -794,11 +804,16 @@ class Engine:
         if not any(prop_by_id.values()):
             return False
 
-        # Reserve growth for the whole chunk before building tables (can
-        # preempt batchmates — or abort; both leave block_table empty).
+        # Reserve each sequence's actual growth (1 committed + its clamped
+        # proposals — NOT the lane-aligned/lcm-inflated s_chunk: the KV
+        # scatter drops invalid positions, so padding needs no pages)
+        # before building tables (can preempt batchmates — or abort; both
+        # leave block_table empty).
         for seq in seqs:
             if seq.block_table:
-                self._reserve_slots_or_preempt(seq, s_chunk)
+                self._reserve_slots_or_preempt(
+                    seq, 1 + len(prop_by_id[seq.seq_id])
+                )
         active = [s for s in seqs if s.block_table]
         if not active:
             return True
@@ -910,6 +925,14 @@ class Engine:
                 seq.num_computed = seq.num_tokens
                 seq.output_tokens.append(tok)
                 seq.num_generated += 1
+            # The dispatch reservation covered exactly the chunk's writes
+            # (positions <= num_tokens + len(prop) - 1). A full acceptance
+            # advances num_tokens past that, so the NEXT dispatch's input
+            # token (written at the new num_tokens - 1) needs its slot
+            # ensured here — same post-emit append every other decode path
+            # does; without it the write lands in padding page 0.
+            if not self._should_finish(seq):
+                self._append_slot_or_preempt(seq)
             self.block_manager.register_full_pages(seq)
         return True
 

@@ -184,6 +184,17 @@ class PodServerConfig:
         eng.spec_decode = os.environ.get("SPEC_DECODE", eng.spec_decode)
         eng.spec_k = int(os.environ.get("SPEC_K", eng.spec_k))
         eng.spec_ngram = int(os.environ.get("SPEC_NGRAM", eng.spec_ngram))
+        # Adaptive-gate knobs (tune or disable the per-sequence acceptance
+        # gate without an image rebuild; SPEC_MIN_ACCEPT=0 disables it).
+        eng.spec_min_accept = float(
+            os.environ.get("SPEC_MIN_ACCEPT", eng.spec_min_accept)
+        )
+        eng.spec_min_sample = int(
+            os.environ.get("SPEC_MIN_SAMPLE", eng.spec_min_sample)
+        )
+        eng.spec_max_scan = int(
+            os.environ.get("SPEC_MAX_SCAN", eng.spec_max_scan)
+        )
         # Weight quantization ("int8" halves weight HBM; models/quant.py).
         eng.quantize = os.environ.get("QUANTIZE") or None
         # CPU smoke runs (Pallas interpreter mode); never set on real TPU.

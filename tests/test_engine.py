@@ -983,9 +983,14 @@ class TestSpeculativeDecode:
             spec_min_accept=1.1,  # nothing can satisfy this
             spec_min_sample=4,
         )
-        seq = eng.add_request(_prompt(60, 10), SamplingParams(max_new_tokens=24))
+        # Budget must leave room for a full-k proposal when the first match
+        # lands: proposals are clamped to max_new_tokens - generated - 1
+        # (drafts past the budget can never be emitted), so a budget that
+        # expires right at the first match would starve the gate's sample
+        # counter instead of exercising the gate.
+        seq = eng.add_request(_prompt(60, 10), SamplingParams(max_new_tokens=40))
         eng.run_until_complete()
-        assert len(seq.generated_tokens) == 24
+        assert len(seq.generated_tokens) == 40
         stats = eng.spec_stats
         # Gate must have ENGAGED, not been vacuously absent: proposals
         # happened, then stopped shortly after the sample threshold — far
