@@ -227,9 +227,9 @@ class TestZMQEndToEnd:
     def test_offline_demo_flow(self):
         from llm_d_kv_cache_manager_tpu.kvcache import KVCacheIndexer, KVCacheIndexerConfig
         from llm_d_kv_cache_manager_tpu.kvcache.kvblock import TokenProcessorConfig
-        from conftest import CharTokenizer as CharTok
+        from conftest import CharTokenizer as CharTok, free_tcp_port
 
-        port = 15571
+        port = free_tcp_port()
         indexer = KVCacheIndexer(
             KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=4)),
             tokenizer=CharTok(),
@@ -295,7 +295,9 @@ class TestZMQReconnect:
 
         monkeypatch.setattr(zmq_subscriber, "_RECONNECT_BACKOFF_S", 0.1)
 
-        port = 15573
+        from conftest import free_tcp_port
+
+        port = free_tcp_port()
         ctx = zmq.Context.instance()
         squatter = ctx.socket(zmq.PUB)
         squatter.bind(f"tcp://*:{port}")
