@@ -64,8 +64,9 @@ class EngineConfig:
     #: decode batch lanes (padded); also the max concurrent running seqs
     decode_batch_size: int = 8
     #: fused decode steps per engine iteration (device-resident loop with
-    #: on-device sampling — one host sync per this many tokens). 1 = the
-    #: classic step-per-token path with host-side sampling.
+    #: on-device sampling — one host sync per this many tokens). 1 = one
+    #: token per dispatch; sampling is on-device at every setting, so no
+    #: config ever round-trips logits to the host.
     decode_steps_per_iter: int = 1
     #: prefill length bucket granularity (shape-bucketing for jit caching)
     prefill_bucket: int = 64
@@ -512,50 +513,13 @@ class Engine:
             # Every lane's proposal came up empty: a verify dispatch would
             # emit exactly one token at prefill-dispatch cost — fall
             # through to the strictly cheaper plain/fused decode step.
-        if self.config.decode_steps_per_iter > 1:
-            self._run_decode_fused(seqs)
-            return
-        lanes = self.config.decode_batch_size
-        assert len(seqs) <= lanes
-        tokens = np.zeros((lanes,), np.int32)
-        positions = np.zeros((lanes,), np.int32)
-        seq_lens = np.zeros((lanes,), np.int32)  # 0 = inactive lane
-        block_tables = np.zeros((lanes, self._decode_table_width(seqs)), np.int32)
-
-        for i, seq in enumerate(seqs):
-            tokens[i] = seq.all_tokens[-1]
-            positions[i] = seq.num_tokens - 1
-            seq_lens[i] = seq.num_tokens
-            bt = seq.block_table
-            block_tables[i, : len(bt)] = bt
-
-        # Flush queued page moves LAST before the dispatch: anything the
-        # dispatch will overwrite must have its spill snapshot read first.
-        self._flush_page_moves()
-        logits, self.k_pages, self.v_pages = llama.decode_step(
-            self.params,
-            self.model_cfg,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(block_tables),
-            jnp.asarray(seq_lens),
-            page_size=self.page_size,
-            interpret=self.config.interpret,
-            mesh=self.mesh,
-        )
-        # Sample over the full padded lane count (stable jit shape), then
-        # keep the active lanes.
-        sampled = self._sample(logits, seqs)[: len(seqs)]
-        for seq, tok in zip(seqs, sampled):
-            if not seq.block_table:
-                continue  # preempted by an earlier seq in this very batch
-            seq.num_computed = seq.num_tokens
-            seq.output_tokens.append(int(tok))
-            seq.num_generated += 1
-            self._append_slot_or_preempt(seq)
-            self.block_manager.register_full_pages(seq)
+        # Every decode goes through the fused path — at k=1 it is the
+        # classic step-per-token loop, but sampling happens ON DEVICE
+        # inside the same dispatch (one transfer of sampled ids instead of
+        # a [lanes, vocab] logit round-trip per token). One decode
+        # implementation; `llama.decode_step` remains as the model-level
+        # logits API for tests and external callers.
+        self._run_decode_fused(seqs)
 
     def _run_decode_fused(self, seqs: list[Sequence]) -> None:
         """Fused multi-token decode: reserve page capacity for the whole
