@@ -828,62 +828,31 @@ def _scatter_kv_pages_all_layers(
     return pages.at[:, pidx, sidx].set(updates, mode="drop")
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "mesh", "attn_impl", "return_all_logits"),
-    donate_argnames=("k_pages", "v_pages"),
-)
-def prefill(
+def _prefill_body(
     params: Params,
     cfg: LlamaConfig,
     tokens: jnp.ndarray,  # [b, s] int32, right-padded
-    positions: jnp.ndarray,  # [b, s] int32 absolute positions (pad value free)
-    valid: jnp.ndarray,  # [b, s] bool — False positions are fully masked
-    k_pages: jnp.ndarray,  # [n_layers, pages, page_size, n_kv, hd]
+    positions: jnp.ndarray,  # [b, s] int32 absolute positions
+    valid: jnp.ndarray,  # [b, s] bool, right-padded prefix mask
+    k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,
-    page_ids: jnp.ndarray,  # [b, s] destination page per token
-    slot_ids: jnp.ndarray,  # [b, s] destination slot per token
-    block_tables: jnp.ndarray,  # [b, max_ctx_pages] int32 — cached-context pages
-    ctx_lens: jnp.ndarray,  # [b] int32 — prefix-cached context length (0 = fresh)
-    mesh=None,  # tp mesh for expert-parallel MoE dispatch
-    attn_impl: str = "xla",  # "xla" (scan flash) | "pallas" (flash kernel)
-    return_all_logits: bool = False,  # [b, s, vocab] for spec-decode verify
+    page_ids: jnp.ndarray,  # [b, s]
+    slot_ids: jnp.ndarray,  # [b, s]
+    block_tables: jnp.ndarray,  # [b, max_ctx_pages]
+    ctx_lens: jnp.ndarray,  # [b]
+    mesh,
+    attn_impl: str,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Process a prompt chunk: returns (logits at last valid position per
-    sequence [b, vocab], updated k_pages, v_pages).
-
-    The chunk attends causally within itself AND to ``ctx_lens`` tokens of
-    prefix-cached context already resident in the page pool — this is how a
-    prefix-cache hit skips recomputing the shared prefix. Fresh sequences
-    pass ``ctx_lens = 0``.
-
-    Mask contract: ``valid`` must be a RIGHT-PADDED prefix mask — per row,
-    ``valid[i] == (arange(s) < n_valid[i])``. The ``xla`` path honors an
-    arbitrary mask exactly, but the ``pallas`` kernel collapses it to a
-    per-sequence count, so a mask with interior holes silently computes
-    wrong attention on ``attn_impl="pallas"``. The engine always satisfies
-    this; non-engine callers can set ``LLMD_CHECK_PREFILL_MASK=1`` to
-    verify at runtime (host-callback assert; small sync cost — debug only).
-    The flag is read at jit TRACE time: set it before the first prefill
-    call of a given shape (or call ``prefill.clear_cache()``) — flipping it
-    after a shape is compiled has no effect on that cached trace.
-    """
-    if attn_impl not in ("xla", "pallas"):
-        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    """Traced prefill layer loop shared by ``prefill`` and the fused
+    speculative-decode scan (``spec_decode_steps``): chunk forward with
+    paged-context attention + one batched KV scatter. Returns (hidden
+    states [b, s, d], k_pages, v_pages); logits selection stays with the
+    caller."""
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
-    if sp > 1 and tokens.shape[1] % sp != 0:
-        raise ValueError(
-            f"chunk length {tokens.shape[1]} not divisible by sp={sp}"
-        )
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     h = _embed(params, cfg, tokens)  # [b, s, d]
     if attn_impl == "pallas":
         n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
-        if os.environ.get("LLMD_CHECK_PREFILL_MASK"):
-            contract = jnp.arange(valid.shape[1])[None, :] < n_valid[:, None]
-            jax.debug.callback(
-                _check_right_padded_mask, jnp.all(contract == valid)
-            )
 
     fresh_k = []  # per-layer [b, s, n_kv, hd] — written to pages in one go
     fresh_v = []
@@ -932,6 +901,66 @@ def prefill(
     )
     v_pages = _scatter_kv_pages_all_layers(
         v_pages, jnp.stack(fresh_v).astype(v_pages.dtype), page_ids, slot_ids, valid
+    )
+    return h, k_pages, v_pages
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh", "attn_impl", "return_all_logits"),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def prefill(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [b, s] int32, right-padded
+    positions: jnp.ndarray,  # [b, s] int32 absolute positions (pad value free)
+    valid: jnp.ndarray,  # [b, s] bool — False positions are fully masked
+    k_pages: jnp.ndarray,  # [n_layers, pages, page_size, n_kv, hd]
+    v_pages: jnp.ndarray,
+    page_ids: jnp.ndarray,  # [b, s] destination page per token
+    slot_ids: jnp.ndarray,  # [b, s] destination slot per token
+    block_tables: jnp.ndarray,  # [b, max_ctx_pages] int32 — cached-context pages
+    ctx_lens: jnp.ndarray,  # [b] int32 — prefix-cached context length (0 = fresh)
+    mesh=None,  # tp mesh for expert-parallel MoE dispatch
+    attn_impl: str = "xla",  # "xla" (scan flash) | "pallas" (flash kernel)
+    return_all_logits: bool = False,  # [b, s, vocab] for spec-decode verify
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Process a prompt chunk: returns (logits at last valid position per
+    sequence [b, vocab], updated k_pages, v_pages).
+
+    The chunk attends causally within itself AND to ``ctx_lens`` tokens of
+    prefix-cached context already resident in the page pool — this is how a
+    prefix-cache hit skips recomputing the shared prefix. Fresh sequences
+    pass ``ctx_lens = 0``.
+
+    Mask contract: ``valid`` must be a RIGHT-PADDED prefix mask — per row,
+    ``valid[i] == (arange(s) < n_valid[i])``. The ``xla`` path honors an
+    arbitrary mask exactly, but the ``pallas`` kernel collapses it to a
+    per-sequence count, so a mask with interior holes silently computes
+    wrong attention on ``attn_impl="pallas"``. The engine always satisfies
+    this; non-engine callers can set ``LLMD_CHECK_PREFILL_MASK=1`` to
+    verify at runtime (host-callback assert; small sync cost — debug only).
+    The flag is read at jit TRACE time: set it before the first prefill
+    call of a given shape (or call ``prefill.clear_cache()``) — flipping it
+    after a shape is compiled has no effect on that cached trace.
+    """
+    if attn_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if sp > 1 and tokens.shape[1] % sp != 0:
+        raise ValueError(
+            f"chunk length {tokens.shape[1]} not divisible by sp={sp}"
+        )
+    if attn_impl == "pallas" and os.environ.get("LLMD_CHECK_PREFILL_MASK"):
+        n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
+        contract = jnp.arange(valid.shape[1])[None, :] < n_valid[:, None]
+        jax.debug.callback(
+            _check_right_padded_mask, jnp.all(contract == valid)
+        )
+    h, k_pages, v_pages = _prefill_body(
+        params, cfg, tokens, positions, valid, k_pages, v_pages,
+        page_ids, slot_ids, block_tables, ctx_lens, mesh, attn_impl,
     )
 
     if return_all_logits:
@@ -1098,3 +1127,205 @@ def decode_steps(
         body, (tokens, positions, seq_lens, k_pages, v_pages), keys
     )
     return toks.T, k_pages, v_pages
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "page_size", "num_rounds", "s_chunk", "ngram", "spec_k",
+        "max_scan", "mesh", "attn_impl",
+    ),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def spec_decode_steps(
+    params: Params,
+    cfg: LlamaConfig,
+    window: jnp.ndarray,  # [b, W] int32 — last-W committed tokens per lane
+    wlen: jnp.ndarray,  # [b] int32 — valid tokens in window (suffix of seq)
+    seq_lens: jnp.ndarray,  # [b] int32 — committed tokens (0 = inactive lane)
+    budgets: jnp.ndarray,  # [b] int32 — remaining emittable tokens
+    gate_open: jnp.ndarray,  # [b] bool — adaptive gate state (host-managed)
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [b, P] int32 — covers the burst's growth
+    temperature: jnp.ndarray,  # [b] f32; 0 = greedy
+    top_k: jnp.ndarray,  # [b] int32
+    top_p: jnp.ndarray,  # [b] f32
+    rng_key: jax.Array,
+    *,
+    page_size: int,
+    num_rounds: int,
+    s_chunk: int,  # verify chunk width (>= spec_k + 1, lane/sp aligned)
+    ngram: int,
+    spec_k: int,
+    max_scan: int,
+    mesh=None,
+    attn_impl: str = "xla",
+) -> tuple[jnp.ndarray, ...]:
+    """``num_rounds`` fused speculative-decode rounds with ON-DEVICE
+    prompt-lookup proposals — one host sync per burst instead of one per
+    verify dispatch (the spec-side analogue of ``decode_steps``; composes
+    speculation with the pipelined-burst idea by chaining rounds through
+    device state rather than the host).
+
+    Each round, per lane: (1) PROPOSE — find the latest earlier occurrence
+    of the window's final ``ngram`` and take up to ``spec_k`` followers,
+    clamped by the remaining token budget and the host's adaptive gate
+    (identical semantics to the host-side ``_propose_prompt_lookup``);
+    (2) VERIFY — one prefill-style forward over
+    ``[last committed token ++ drafts]`` against the paged context
+    (``_prefill_body``), full-position logits; (3) ACCEPT — greedy lanes
+    take the longest draft prefix matching argmax plus the correction,
+    temperature>0 lanes run deterministic-draft speculative sampling
+    (``ops/sampling.spec_sample``); (4) COMMIT ON DEVICE — append the
+    emitted tokens to the window and advance ``seq_lens``/``budgets``, so
+    the next round proposes from the updated context with no host
+    round-trip. Rejected drafts leave stale KV beyond ``seq_lens`` in
+    pages the sequence owns; the next round's chunk rewrite of the
+    corrected position and the host's budget-bounded commits make that
+    pure bookkeeping (same argument as the fused-burst surplus tokens).
+
+    The caller sizes ``window`` so it cannot overflow
+    (``W >= max wlen + num_rounds * (spec_k + 1)``) and pre-reserves pages
+    for the worst-case growth. A lane whose budget hits 0 keeps verifying
+    its last position (emitting nothing) — wasted-but-safe, like finished
+    lanes inside a fused burst.
+
+    Returns ``(emit [rounds, b, spec_k+1], emit_len [rounds, b],
+    prop_len [rounds, b], acc [rounds, b], k_pages, v_pages)``.
+    """
+    b, W = window.shape
+    n = ngram
+    k = spec_k
+    # Window-base offset: window[j] holds the token at global position
+    # base + j. Both wlen and seq_lens advance by emit_len per round, so
+    # base is constant across the scan.
+    base = seq_lens - wlen  # [b]
+
+    def round_body(carry, key):
+        window, wlen, seq_lens, budget, k_pages, v_pages = carry
+        active = seq_lens > 0
+
+        # ---- propose (vectorized prompt lookup over the window) --------
+        patt_idx = wlen[:, None] - n + jnp.arange(n)[None, :]  # [b, n]
+        pattern = jnp.take_along_axis(
+            window, jnp.clip(patt_idx, 0, W - 1), axis=1
+        )  # [b, n]
+        j = jnp.arange(W)[None, :]  # candidate match starts (window coords)
+        m = jnp.ones((b, W), bool)
+        for o in range(n):  # ngram is static and small
+            wo = jnp.take_along_axis(window, jnp.clip(j + o, 0, W - 1), axis=1)
+            m = m & (wo == pattern[:, o : o + 1]) & (j + o < W)
+        # Host-parity validity: start <= len-n-1 (terminal occurrence
+        # excluded) and start >= len-1-max_scan (in global coords).
+        m = m & (j + n <= wlen[:, None] - 1)
+        m = m & (j + base[:, None] >= seq_lens[:, None] - 1 - max_scan)
+        latest = jnp.max(jnp.where(m, j, -1), axis=1)  # [b]
+        has = latest >= 0
+        avail = wlen - (latest + n)  # followers available (>= 1 when has)
+        # Budget clamp mirrors the host: drafts past budget-1 can never be
+        # emitted (the verify emits accepted+1).
+        prop_len = jnp.where(
+            has & gate_open & active,
+            jnp.minimum(jnp.minimum(k, avail), jnp.maximum(budget - 1, 0)),
+            0,
+        ).astype(jnp.int32)
+        didx = latest[:, None] + n + jnp.arange(k)[None, :]
+        drafts = jnp.take_along_axis(
+            window, jnp.clip(didx, 0, W - 1), axis=1
+        )  # [b, k] (garbage beyond prop_len — masked below)
+
+        # ---- build the verify chunk ------------------------------------
+        last_tok = jnp.take_along_axis(
+            window, jnp.clip(wlen - 1, 0, W - 1)[:, None], axis=1
+        )[:, 0]
+        chunk = jnp.concatenate(
+            [last_tok[:, None], drafts,
+             jnp.zeros((b, s_chunk - 1 - k), jnp.int32)],
+            axis=1,
+        )  # [b, s_chunk]
+        n_chunk = 1 + prop_len
+        jj = jnp.arange(s_chunk)[None, :]
+        valid = (jj < n_chunk[:, None]) & active[:, None]
+        start = jnp.maximum(seq_lens - 1, 0)
+        positions = start[:, None] + jj  # [b, s_chunk]
+        P = block_tables.shape[1]
+        page_ids = jnp.take_along_axis(
+            block_tables, jnp.clip(positions // page_size, 0, P - 1), axis=1
+        )
+        slot_ids = positions % page_size
+        h, k_pages, v_pages = _prefill_body(
+            params, cfg, chunk, positions, valid, k_pages, v_pages,
+            page_ids, slot_ids, block_tables, start, mesh, attn_impl,
+        )
+        logits = _logits(params, cfg, h)  # [b, s_chunk, vocab] f32
+
+        # ---- accept ----------------------------------------------------
+        # logits[j] predict the token AFTER chunk[j]; the draft under test
+        # there is chunk[j+1], so drafts shift left by one.
+        drafts_shift = jnp.concatenate(
+            [chunk[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1
+        )
+
+        def verify_greedy(logits, drafts_s, key):
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return g == drafts_s, g, g
+
+        def verify_sampled(logits, drafts_s, key):
+            from ..ops.sampling import spec_sample
+
+            return spec_sample(
+                logits, drafts_s, temperature, top_k, top_p, key
+            )
+
+        # All-greedy bursts skip the filtered-distribution sorts entirely.
+        accept, replacement, free = jax.lax.cond(
+            jnp.any(temperature > 0), verify_sampled, verify_greedy,
+            logits, drafts_shift, key,
+        )
+        lead = jnp.cumprod(accept[:, :k].astype(jnp.int32), axis=1)  # [b, k]
+        acc = jnp.sum(
+            lead * (jnp.arange(k)[None, :] < prop_len[:, None]), axis=1
+        ).astype(jnp.int32)  # leading accepts among the real drafts
+        corrected = jnp.where(
+            acc < prop_len,
+            jnp.take_along_axis(replacement, acc[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(free, acc[:, None], axis=1)[:, 0],
+        )
+        kk = jnp.arange(k + 1)[None, :]
+        drafts_pad = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1
+        )
+        emit = jnp.where(
+            kk < acc[:, None],
+            drafts_pad,
+            jnp.where(kk == acc[:, None], corrected[:, None], 0),
+        )  # [b, k+1]
+        emit_len = jnp.where(
+            active & (budget > 0), jnp.minimum(acc + 1, budget), 0
+        ).astype(jnp.int32)
+
+        # ---- commit on device (window / lengths / budget) --------------
+        rows = jnp.arange(b)[:, None]
+        widx = jnp.clip(wlen[:, None] + kk, 0, W - 1)
+        cur = jnp.take_along_axis(window, widx, axis=1)
+        updates = jnp.where(kk < emit_len[:, None], emit, cur)
+        window = window.at[rows, widx].set(updates)
+        wlen = wlen + emit_len
+        seq_lens = seq_lens + emit_len
+        budget = budget - emit_len
+
+        return (
+            (window, wlen, seq_lens, budget, k_pages, v_pages),
+            (emit, emit_len, prop_len, acc),
+        )
+
+    keys = jax.random.split(rng_key, num_rounds)
+    (_, _, _, _, k_pages, v_pages), (emit, emit_len, prop_len, acc) = (
+        jax.lax.scan(
+            round_body,
+            (window, wlen, seq_lens, budgets, k_pages, v_pages),
+            keys,
+        )
+    )
+    return emit, emit_len, prop_len, acc, k_pages, v_pages

@@ -29,7 +29,7 @@ from ..models import llama
 from ..models.llama import LlamaConfig
 from ..utils import get_logger
 from .block_manager import AllocationError, BlockManager, BlockManagerConfig
-from ..ops.sampling import sample_tokens, spec_sample
+from ..ops.sampling import sample_tokens
 from .scheduler import Scheduler, SchedulerConfig
 from .sequence import SamplingParams, Sequence, SequenceStatus
 
@@ -125,6 +125,18 @@ class EngineConfig:
     spec_ngram: int = 3
     #: cap on how far back the proposal search scans (host-side cost)
     spec_max_scan: int = 4096
+    #: fused speculative rounds per dispatch: propose → verify → accept →
+    #: advance runs ``spec_rounds`` times ON DEVICE per host sync
+    #: (proposals matched against a device-resident token window;
+    #: llama.spec_decode_steps). 1 = one verify per dispatch (the classic
+    #: loop, still with on-device acceptance; it pays the window upload —
+    #: ~4 B x min(spec_max_scan, max_model_len) per lane per burst, noise
+    #: next to a dispatch — to keep ONE spec implementation). Raising this
+    #: composes speculation with the fused-burst idea: per-dispatch host
+    #: latency is amortized over rounds, at the cost of gate/fallback
+    #: decisions lagging a burst (a round whose proposals dry up degrades
+    #: to a one-token verify round instead of a cheaper plain decode).
+    spec_rounds: int = 1
     #: adaptive per-sequence gate: once a sequence has had at least
     #: spec_min_sample proposed tokens, stop proposing for it while its
     #: acceptance rate sits below spec_min_accept — a low-acceptance
@@ -213,9 +225,14 @@ class Engine:
                 raise ValueError("spec_k must be >= 1")
             if config.spec_ngram < 1:
                 raise ValueError("spec_ngram must be >= 1")
-        #: speculative-decode observability: proposed/accepted draft tokens
-        #: and verify dispatches (acceptance rate = accepted/proposed).
-        self.spec_stats = {"proposed": 0, "accepted": 0, "verify_steps": 0}
+            if config.spec_rounds < 1:
+                raise ValueError("spec_rounds must be >= 1")
+        #: speculative-decode observability: proposed/accepted draft
+        #: tokens, verify ROUNDS, and host-sync bursts (acceptance rate =
+        #: accepted/proposed; rounds-per-sync = verify_steps/bursts).
+        self.spec_stats = {
+            "proposed": 0, "accepted": 0, "verify_steps": 0, "bursts": 0,
+        }
         self.prefill_attn = config.prefill_attn
         if self.prefill_attn == "auto":
             self.prefill_attn = (
@@ -691,25 +708,19 @@ class Engine:
         extractive/structured generations where the output echoes the
         prompt). Host-side, O(spec_max_scan)."""
         n = self.config.spec_ngram
-        # Clamp to the remaining token budget: drafts past max_new_tokens-1
-        # (the verify emits accepted+1) or max_model_len-1 can never be
-        # emitted — scoring them would reserve pages and KV-write positions
-        # past the effective cap for nothing under pool pressure.
-        k = min(
-            self.config.spec_k,
-            seq.sampling.max_new_tokens - seq.num_generated - 1,
-            self.config.max_model_len - seq.num_tokens - 1,
-        )
+        # Clamp to the remaining token budget: drafts past budget-1 (the
+        # verify emits accepted+1) can never be emitted — scoring them
+        # would reserve pages and KV-write positions past the effective
+        # cap for nothing under pool pressure. Shares _spec_budget with
+        # the device path: round-1 device prop_len must equal this k for
+        # the exact single-round reservation to cover the KV writes.
+        k = min(self.config.spec_k, self._spec_budget(seq) - 1)
         if k < 1:
             return []
         toks = seq.all_tokens
         if len(toks) < n + 1:
             return []
-        if (
-            seq.spec_proposed >= self.config.spec_min_sample
-            and seq.spec_accepted
-            < self.config.spec_min_accept * seq.spec_proposed
-        ):
+        if not self._gate_open(seq):
             return []  # adaptive gate: this sequence isn't echoing
         pattern = toks[-n:]
         lo = max(0, len(toks) - 1 - self.config.spec_max_scan)
@@ -720,22 +731,51 @@ class Engine:
                 return [int(t) for t in toks[start + n : start + n + k]]
         return []
 
+    def _gate_open(self, seq: Sequence) -> bool:
+        """Adaptive spec gate (one-way, per sequence): closed once the
+        sample fills with acceptance below the threshold."""
+        return not (
+            seq.spec_proposed >= self.config.spec_min_sample
+            and seq.spec_accepted
+            < self.config.spec_min_accept * seq.spec_proposed
+        )
+
+    def _spec_budget(self, seq: Sequence) -> int:
+        """Remaining emittable tokens (max_new_tokens and max_model_len
+        caps) — the ONE definition both the host proposal clamp and the
+        device burst's budget array derive from; their agreement is what
+        lets the single-round reservation size off the host proposal."""
+        return max(
+            0,
+            min(
+                seq.sampling.max_new_tokens - seq.num_generated,
+                self.config.max_model_len - seq.num_tokens,
+            ),
+        )
+
     def _run_decode_spec(self, seqs: list[Sequence]) -> bool:
-        """Speculative decode via prompt-lookup: ONE verify dispatch scores
-        the last committed token plus up to ``spec_k`` proposed tokens —
-        exactly a warm prefill over [paged context ++ chunk] (the chunk is
-        [t_last, d_1..d_m], positions from num_tokens-1, context =
-        num_tokens-1 committed tokens) with full-position logits. Greedy
-        lanes accept the longest proposal prefix matching the model's own
-        argmax, plus the argmax at the first mismatch (or a bonus token
-        when everything matched); temperature>0 lanes run
-        deterministic-draft speculative SAMPLING via
-        ``ops/sampling.spec_sample`` (accept draft with prob P(draft);
-        residual sample on rejection; unconditioned bonus) — exact for
-        each lane's filtered distribution. Either way a step emits
-        1..k+1 tokens and never fewer than plain decode. Returns False
-        (nothing dispatched) when every lane's proposal is empty; the
-        caller then runs the cheaper plain/fused step.
+        """Speculative decode via prompt-lookup, fused on device: each
+        verify round scores the last committed token plus up to ``spec_k``
+        proposed tokens — exactly a warm prefill over
+        [paged context ++ chunk] with full-position logits — and
+        ``spec_rounds`` rounds run inside ONE dispatch
+        (``llama.spec_decode_steps``): proposals are matched against a
+        device-resident token window, acceptance is computed on device,
+        and the window/positions advance on device, so the host syncs once
+        per burst instead of once per verify. This composes speculation
+        with the fused-burst idea — the serial host round-trip the old
+        single-round path paid per verify is amortized across rounds.
+
+        Acceptance: greedy lanes take the longest proposal prefix matching
+        the model's own argmax, plus the argmax at the first mismatch (or
+        a bonus token when everything matched); temperature>0 lanes run
+        deterministic-draft speculative SAMPLING (``ops/sampling.
+        spec_sample``) — exact for each lane's filtered distribution.
+        A round emits 1..k+1 tokens per lane and never fewer than plain
+        decode. Returns False (nothing dispatched) when every lane's
+        round-1 proposal is empty; the caller then runs the cheaper
+        plain/fused step. Later rounds whose proposals dry up degrade to
+        one-token verify rounds (correct; costs one chunk forward).
 
         Greedy emitted tokens are the model's choices as scored by the
         PREFILL path; in interpret/XLA numerics that is bit-identical to
@@ -756,145 +796,137 @@ class Engine:
 
         ps = self.page_size
         k = self.config.spec_k
+        rounds = self.config.spec_rounds
         # Chunk width must satisfy both the lane alignment and the sp
         # sharding of the prefill path.
         s_chunk = _round_up(k + 1, math.lcm(8, max(1, self.config.sp)))
         b = self.config.decode_batch_size
         assert len(seqs) <= b
 
-        # Proposals are host-side and cheap: compute BEFORE reserving so an
-        # all-empty round costs nothing (caller falls back to plain decode).
+        # Round-1 proposals are recomputed on device; this host pass (same
+        # algorithm) only decides entry — an all-empty round must cost
+        # nothing (caller falls back to plain decode) — and sizes the
+        # exact single-round reservation.
         prop_by_id = {s.seq_id: self._propose_prompt_lookup(s) for s in seqs}
         if not any(prop_by_id.values()):
             return False
 
-        # Reserve each sequence's actual growth (1 committed + its clamped
+        # Reserve before building tables (can preempt batchmates — or
+        # abort; both leave block_table empty). Single-round bursts
+        # reserve the sequence's exact growth (1 committed + its clamped
         # proposals — NOT the lane-aligned/lcm-inflated s_chunk: the KV
-        # scatter drops invalid positions, so padding needs no pages)
-        # before building tables (can preempt batchmates — or abort; both
-        # leave block_table empty).
+        # scatter drops invalid positions, so padding needs no pages);
+        # multi-round bursts reserve the budget-capped worst case, since
+        # later rounds' proposals are decided on device.
         for seq in seqs:
-            if seq.block_table:
-                self._reserve_slots_or_preempt(
-                    seq, 1 + len(prop_by_id[seq.seq_id])
-                )
+            if not seq.block_table:
+                continue
+            if rounds == 1:
+                n_res = 1 + len(prop_by_id[seq.seq_id])
+            else:
+                n_res = 1 + min(rounds * (k + 1), self._spec_budget(seq))
+            self._reserve_slots_or_preempt(seq, n_res)
         active = [s for s in seqs if s.block_table]
         if not active:
             return True
 
-        proposals = [prop_by_id[s.seq_id] for s in active]
-
-        tokens = np.zeros((b, s_chunk), np.int32)
-        positions = np.zeros((b, s_chunk), np.int32)
-        valid = np.zeros((b, s_chunk), bool)
-        page_ids = np.zeros((b, s_chunk), np.int32)
-        slot_ids = np.zeros((b, s_chunk), np.int32)
-        max_ctx = max((s.num_tokens - 1) // ps + 1 for s in active)
-        ctx_pages = _round_up(max_ctx, max(1, self.config.decode_pages_bucket))
-        ctx_bt = np.zeros((b, ctx_pages), np.int32)
-        ctx_lens = np.zeros((b,), np.int32)
-
-        for i, (seq, prop) in enumerate(zip(active, proposals)):
-            n_chunk = 1 + len(prop)
-            tokens[i, 0] = seq.all_tokens[-1]
-            tokens[i, 1 : n_chunk] = prop
-            start = seq.num_tokens - 1  # last committed token's position
-            pos = np.arange(start, start + n_chunk)
-            positions[i, :n_chunk] = pos
-            valid[i, :n_chunk] = True
-            bt = np.asarray(seq.block_table, np.int32)
-            page_ids[i, :n_chunk] = bt[pos // ps]
-            slot_ids[i, :n_chunk] = pos % ps
-            n_ctx = (start // ps) + (1 if start % ps else 0)
-            ctx_bt[i, :n_ctx] = bt[:n_ctx]
-            ctx_lens[i] = start
-
-        self._flush_page_moves()
-        logits, self.k_pages, self.v_pages = llama.prefill(
-            self.params,
-            self.model_cfg,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(valid),
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(page_ids),
-            jnp.asarray(slot_ids),
-            jnp.asarray(ctx_bt),
-            jnp.asarray(ctx_lens),
-            mesh=self.mesh,
-            attn_impl=self.prefill_attn,
-            return_all_logits=True,
+        # Device-resident token window: the last `scan_need` committed
+        # tokens (everything prompt lookup may match against) plus room
+        # for the burst's growth.
+        scan_need = min(
+            self.config.spec_max_scan + self.config.spec_ngram + 1,
+            self.config.max_model_len,
         )
-        # Verification: greedy lanes accept iff draft == argmax; sampled
-        # lanes run deterministic-draft speculative sampling (accept with
-        # prob P(draft), residual sample on rejection) — exact for each
-        # lane's filtered distribution (ops/sampling.spec_sample).
+        W = scan_need + rounds * (k + 1)
+        window = np.zeros((b, W), np.int32)
+        wlen = np.zeros((b,), np.int32)
+        seq_lens = np.zeros((b,), np.int32)  # 0 = inactive lane
+        budgets = np.zeros((b,), np.int32)
+        gate_open = np.zeros((b,), bool)
         temperature = np.zeros((b,), np.float32)
         top_k_arr = np.zeros((b,), np.int32)
         top_p_arr = np.ones((b,), np.float32)
+        block_tables = np.zeros((b, self._decode_table_width(active)), np.int32)
+
         for i, seq in enumerate(active):
+            toks = seq.all_tokens
+            n_win = min(len(toks), scan_need)
+            window[i, :n_win] = toks[-n_win:]
+            wlen[i] = n_win
+            seq_lens[i] = seq.num_tokens
+            budgets[i] = self._spec_budget(seq)
+            gate_open[i] = self._gate_open(seq)
             temperature[i] = seq.sampling.temperature
             top_k_arr[i] = seq.sampling.top_k
             top_p_arr[i] = seq.sampling.top_p
-        # Position alignment: logits[j] predict the token AFTER chunk[j];
-        # the draft under test there is chunk[j+1], so drafts shift left.
-        # The trailing slot has no draft and is only ever read by `free`
-        # (which ignores the draft).
-        drafts = np.zeros((b, s_chunk), np.int32)
-        drafts[:, :-1] = tokens[:, 1:]
-        if not (temperature > 0).any():
-            # All-greedy fast path (the common spec workload): one argmax,
-            # one transfer — no filtered-distribution sorts, no categorical
-            # draws, and the engine rng is left untouched.
-            greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [b, s_chunk]
-            accept = greedy == drafts
-            replacement = greedy
-            free = greedy
-        else:
+            block_tables[i, : len(seq.block_table)] = seq.block_table
+
+        self._flush_page_moves()
+        if (temperature > 0).any():
             self._rng, key = jax.random.split(self._rng)
-            accept_d, replacement_d, free_d = spec_sample(
-                logits,
-                jnp.asarray(drafts),
+        else:
+            # All-greedy burst: the device cond never reads the key —
+            # leave the engine rng untouched (sampled streams elsewhere in
+            # the run must not shift because a greedy lane speculated).
+            key = jax.random.PRNGKey(0)
+        emit, emit_len, prop_len, acc, self.k_pages, self.v_pages = (
+            llama.spec_decode_steps(
+                self.params,
+                self.model_cfg,
+                jnp.asarray(window),
+                jnp.asarray(wlen),
+                jnp.asarray(seq_lens),
+                jnp.asarray(budgets),
+                jnp.asarray(gate_open),
+                self.k_pages,
+                self.v_pages,
+                jnp.asarray(block_tables),
                 jnp.asarray(temperature),
                 jnp.asarray(top_k_arr),
                 jnp.asarray(top_p_arr),
                 key,
+                page_size=ps,
+                num_rounds=rounds,
+                s_chunk=s_chunk,
+                ngram=self.config.spec_ngram,
+                spec_k=k,
+                max_scan=self.config.spec_max_scan,
+                mesh=self.mesh,
+                attn_impl=self.prefill_attn,
             )
-            accept = np.asarray(accept_d)
-            replacement = np.asarray(replacement_d)
-            free = np.asarray(free_d)
+        )
+        # The one host sync of the burst.
+        emit = np.asarray(emit)  # [rounds, b, k+1]
+        emit_len = np.asarray(emit_len)  # [rounds, b]
+        prop_len = np.asarray(prop_len)
+        acc = np.asarray(acc)
 
-        self.spec_stats["verify_steps"] += 1
-        for i, (seq, prop) in enumerate(zip(active, proposals)):
+        self.spec_stats["verify_steps"] += rounds
+        self.spec_stats["bursts"] += 1
+        for i, seq in enumerate(active):
             if not seq.block_table:
                 continue  # preempted by a batchmate's reservation
-            accepted = 0
-            while accepted < len(prop) and bool(accept[i, accepted]):
-                accepted += 1
-            self.spec_stats["proposed"] += len(prop)
-            self.spec_stats["accepted"] += accepted
-            seq.spec_proposed += len(prop)
-            seq.spec_accepted += accepted
-            # Accepted drafts + the replacement at the first rejection
-            # (or an unconditioned bonus sample when every draft matched).
-            if accepted < len(prop):
-                corrected = int(replacement[i, accepted])
-            else:
-                corrected = int(free[i, accepted])
-            emit = prop[:accepted] + [corrected]
-            for tok in emit:
+            for r in range(rounds):
+                pl = int(prop_len[r, i])
+                ac = int(acc[r, i])
+                self.spec_stats["proposed"] += pl
+                self.spec_stats["accepted"] += ac
+                seq.spec_proposed += pl
+                seq.spec_accepted += ac
+                for j in range(int(emit_len[r, i])):
+                    if self._should_finish(seq):
+                        break
+                    seq.num_computed = seq.num_tokens
+                    seq.output_tokens.append(int(emit[r, i, j]))
+                    seq.num_generated += 1
                 if self._should_finish(seq):
-                    break
-                seq.num_computed = seq.num_tokens
-                seq.output_tokens.append(tok)
-                seq.num_generated += 1
-            # The dispatch reservation covered exactly the chunk's writes
-            # (positions <= num_tokens + len(prop) - 1). A full acceptance
-            # advances num_tokens past that, so the NEXT dispatch's input
-            # token (written at the new num_tokens - 1) needs its slot
-            # ensured here — same post-emit append every other decode path
-            # does; without it the write lands in padding page 0.
+                    break  # later rounds are surplus (discarded)
+            # The burst reservation covered exactly the burst's writes; a
+            # full acceptance in the last committed round advances
+            # num_tokens past them, so the NEXT dispatch's input token
+            # (written at the new num_tokens - 1) needs its slot ensured
+            # here — same post-emit append every other decode path does;
+            # without it the write lands in padding page 0.
             if not self._should_finish(seq):
                 self._append_slot_or_preempt(seq)
             self.block_manager.register_full_pages(seq)

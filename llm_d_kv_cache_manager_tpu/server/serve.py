@@ -90,10 +90,18 @@ class _ServingMetrics:
         )
         self.spec_verify = prom.Counter(
             "tpu_pod_spec_verify_steps_total",
-            "Speculative verify dispatches",
+            "Speculative verify rounds",
             registry=self.registry,
         )
-        self._spec_seen = {"proposed": 0, "accepted": 0, "verify_steps": 0}
+        self.spec_bursts = prom.Counter(
+            "tpu_pod_spec_bursts_total",
+            "Speculative host-sync bursts (verify rounds per host sync = "
+            "verify_steps/bursts)",
+            registry=self.registry,
+        )
+        self._spec_seen = {
+            "proposed": 0, "accepted": 0, "verify_steps": 0, "bursts": 0,
+        }
 
     def sync_spec_stats(self, stats: dict) -> None:
         """Mirror the engine's monotone spec counters into Prometheus."""
@@ -103,8 +111,9 @@ class _ServingMetrics:
             ("proposed", self.spec_proposed),
             ("accepted", self.spec_accepted),
             ("verify_steps", self.spec_verify),
+            ("bursts", self.spec_bursts),
         ):
-            delta = stats[key] - self._spec_seen[key]
+            delta = stats.get(key, 0) - self._spec_seen[key]
             if delta > 0:
                 counter.inc(delta)
                 self._spec_seen[key] = stats[key]
@@ -184,6 +193,9 @@ class PodServerConfig:
         eng.spec_decode = os.environ.get("SPEC_DECODE", eng.spec_decode)
         eng.spec_k = int(os.environ.get("SPEC_K", eng.spec_k))
         eng.spec_ngram = int(os.environ.get("SPEC_NGRAM", eng.spec_ngram))
+        # Fused speculative rounds per dispatch (device-chained
+        # propose/verify/accept; amortizes per-dispatch host latency).
+        eng.spec_rounds = int(os.environ.get("SPEC_ROUNDS", eng.spec_rounds))
         # Adaptive-gate knobs (tune or disable the per-sequence acceptance
         # gate without an image rebuild; SPEC_MIN_ACCEPT=0 disables it).
         eng.spec_min_accept = float(

@@ -998,6 +998,39 @@ class TestSpeculativeDecode:
         assert stats["proposed"] >= eng.config.spec_min_sample
         assert stats["proposed"] <= eng.config.spec_min_sample + eng.config.spec_k
 
+    def test_fused_rounds_same_tokens_fewer_host_syncs(self):
+        # The point of spec_rounds: an echo-heavy workload decodes the
+        # same greedy stream with ~rounds× fewer host syncs (bursts).
+        prompt = ([7, 3, 9, 5, 2] * 6)[:28]
+        streams, bursts = [], []
+        for rounds in (1, 4):
+            eng = _engine(
+                spec_decode="prompt_lookup", spec_k=4, spec_ngram=2,
+                spec_rounds=rounds,
+            )
+            seq = eng.add_request(prompt, SamplingParams(max_new_tokens=20))
+            eng.run_until_complete()
+            streams.append(seq.generated_tokens)
+            bursts.append(eng.spec_stats["bursts"])
+            assert len(seq.generated_tokens) == 20
+            # Every dispatched round is accounted.
+            assert eng.spec_stats["verify_steps"] == rounds * eng.spec_stats["bursts"]
+        assert streams[0] == streams[1]
+        assert bursts[1] < bursts[0], (bursts, "fused rounds should cut syncs")
+
+    def test_fused_rounds_respect_budget_clamp(self):
+        # A lane whose budget expires mid-burst must stop emitting exactly
+        # at max_new_tokens even though the device keeps verifying.
+        prompt = ([4, 8, 1] * 8)[:20]
+        eng = _engine(
+            spec_decode="prompt_lookup", spec_k=4, spec_ngram=2,
+            spec_rounds=4,
+        )
+        seq = eng.add_request(prompt, SamplingParams(max_new_tokens=6))
+        eng.run_until_complete()
+        assert len(seq.generated_tokens) == 6
+        assert seq.num_tokens <= eng.config.max_model_len
+
 
 class TestDecodePathParityFuzz:
     """Randomized cross-path parity: for random prompts/arrival patterns
@@ -1024,6 +1057,21 @@ class TestDecodePathParityFuzz:
             spec_k=3,
             spec_ngram=2,
         ),
+        # FUSED multi-round spec: propose/verify/accept chained on device,
+        # one host sync per 3 rounds (llama.spec_decode_steps scan)
+        dict(spec_decode="prompt_lookup", spec_k=3, spec_ngram=2,
+             spec_rounds=3),
+        # interaction: fused spec rounds through an sp-sharded prefill body
+        dict(sp=2, spec_decode="prompt_lookup", spec_k=3, spec_ngram=2,
+             spec_rounds=2),
+        # interaction: fused spec rounds + host-DRAM tier page moves
+        dict(host_pages=16, spec_decode="prompt_lookup", spec_k=3,
+             spec_ngram=2, spec_rounds=3),
+        # interaction: fused spec rounds with the empty-proposal fallback
+        # landing in pipelined fused bursts
+        dict(decode_steps_per_iter=3, decode_pipeline=True,
+             spec_decode="prompt_lookup", spec_k=3, spec_ngram=2,
+             spec_rounds=3),
     ]
 
     @pytest.mark.parametrize("seed", [101, 202, 303])
