@@ -51,6 +51,11 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
                        0 restores the drain-everything optimistic co-sim)
   BENCH_EST_TTL_S=N    estimated-router affinity TTL (default off; the
                        capacity-LRU is the binding bound in these runs)
+  BENCH_PRESSURE=0     skip the second (pool-pressure) pass
+  BENCH_PRESSURE_PAGES=N pressure-pass pool size (default 1536 @1p4b,
+                       640 @8b-int8 — past the working set, so pods evict
+                       and the index's eviction awareness shows; the
+                       reference's own headline regime)
 """
 
 from __future__ import annotations
@@ -538,6 +543,37 @@ def main() -> int:
             policy, workload, params, engine_cfg, n_pods, max_new
         )
 
+    # -- Pressure regime (the product's differentiator) -------------------
+    # Under an ample pool, index-free affinity ("estimated") ties precise:
+    # nothing it believes about pod caches is ever wrong. The index's
+    # reason to exist is EVICTION AWARENESS, which only shows when pods
+    # actually evict — the reference's own headline regime
+    # (37-capacity/README.md:235-238: precise p90 0.275 s vs estimated
+    # 7.5 s at capacity). Re-run rr/estimated/precise on the same workload
+    # with the pool shrunk past the working set so the round record
+    # carries both regimes (results/routing_capacity.md measured
+    # estimated's p90 ~1.9x worse there).
+    pressure_results = {}
+    pressure_pages = 0
+    if os.environ.get("BENCH_PRESSURE", "1") == "1":
+        default_pp = {"1p4b": 1536, "8b-int8": 640}.get(
+            model_label, max(total_pages // 2, 32)
+        )
+        pressure_pages = int(os.environ.get("BENCH_PRESSURE_PAGES", default_pp))
+        import dataclasses
+
+        pressure_cfg = dataclasses.replace(
+            engine_cfg,
+            block_manager=dataclasses.replace(
+                engine_cfg.block_manager, total_pages=pressure_pages
+            ),
+        )
+        for policy in ("round_robin", "estimated", "precise"):
+            if policy in policies:
+                pressure_results[policy] = run_policy(
+                    policy, workload, params, pressure_cfg, n_pods, max_new
+                )
+
     # Headline metrics are precise-vs-round_robin by definition: when a
     # BENCH_POLICIES subset omits either, the corresponding fields are
     # null rather than silently reporting another policy's numbers.
@@ -565,8 +601,27 @@ def main() -> int:
         "event_lag_ms": float(os.environ.get("BENCH_EVENT_LAG_MS", "2")),
         "qps_ramp": [round(q, 2) for q in qps_ramp],
         "results": results,
+        "pressure_total_pages": pressure_pages,
+        "pressure_results": pressure_results,
     }
     print(json.dumps(detail), file=sys.stderr)
+
+    pressure = None
+    if pressure_results:
+        pressure = {"total_pages": pressure_pages}
+        for pol, res in pressure_results.items():
+            pressure[f"p50_{pol}"] = round(res["p50_ttft_s"], 4)
+            pressure[f"p90_{pol}"] = round(res["p90_ttft_s"], 4)
+        pe, pp = (
+            pressure_results.get("estimated"),
+            pressure_results.get("precise"),
+        )
+        if pe and pp and pp["p90_ttft_s"] > 0:
+            # The eviction-awareness headline: how much worse the
+            # index-free router's tail is once pods evict.
+            pressure["p90_estimated_over_precise"] = round(
+                pe["p90_ttft_s"] / pp["p90_ttft_s"], 3
+            )
     print(
         json.dumps(
             {
@@ -585,6 +640,7 @@ def main() -> int:
                 "output_tok_s_per_chip": (
                     round(precise["output_tok_s_per_chip"], 1) if precise else None
                 ),
+                "pressure": pressure,
             }
         )
     )
