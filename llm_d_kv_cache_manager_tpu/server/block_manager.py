@@ -86,16 +86,25 @@ class BlockManager:
         # this class only does the tiering bookkeeping.
         self._copy_out = None  # (device_page, host_slot) -> None
         self._copy_in = None  # (host_slot, device_page) -> None
+        self._restore_policy = None  # (n_pages) -> bool; None = always
         self._host_free: list[int] = list(range(config.host_pages - 1, -1, -1))
         self._host_cached: dict[int, int] = {}  # chain_hash -> host slot
         self._host_info: dict[int, _PageInfo] = {}  # host slot -> metadata
         self._host_lru: OrderedDict[int, None] = OrderedDict()  # host slots
 
-    def attach_host_pool(self, copy_out, copy_in) -> None:
+    def attach_host_pool(self, copy_out, copy_in, restore_policy=None) -> None:
         """Install the engine's device↔host page movers, enabling the
-        host-DRAM offload tier (``config.host_pages`` > 0)."""
+        host-DRAM offload tier (``config.host_pages`` > 0).
+
+        ``restore_policy(n_pages) -> bool``, when given, is the
+        recompute-vs-restore cost model: consulted once per contiguous
+        host-cached run during ``allocate``, it answers whether restoring
+        ``n_pages`` beats recomputing their tokens (the engine answers
+        from online-measured restore/prefill rates). ``None`` keeps the
+        always-restore behavior."""
         self._copy_out = copy_out
         self._copy_in = copy_in
+        self._restore_policy = restore_policy
 
     @property
     def num_host_cached_pages(self) -> int:
@@ -251,8 +260,29 @@ class BlockManager:
 
         block_table: list[int] = []
         cached_tokens = 0
-        for h in hashes:
+        restore_until = -1  # hash index below which restores are approved
+        for i, h in enumerate(hashes):
             page = self._cached.get(h)
+            if (
+                page is None
+                and self._restore_policy is not None
+                and i > restore_until
+                and h in self._host_cached
+            ):
+                # First touch of a contiguous host-cached run: consult the
+                # recompute-vs-restore cost model ONCE for the whole run.
+                # (Modeled per-run, not per-prompt: declining only forces
+                # recompute of these blocks — allocate stops here either
+                # way, so anything beyond the run is recomputed regardless.)
+                run = 0
+                while (
+                    i + run < len(hashes)
+                    and hashes[i + run] in self._host_cached
+                ):
+                    run += 1
+                if not self._restore_policy(run):
+                    break  # cheaper to recompute than to DMA the run in
+                restore_until = i + run - 1
             if page is None:
                 page = self._try_restore(h)
             if page is None:

@@ -267,6 +267,14 @@ class Engine:
             self.k_pages = jax.device_put(self.k_pages, sh)
             self.v_pages = jax.device_put(self.v_pages, sh)
 
+        # Online rate estimates driving the recompute-vs-restore cost
+        # model (EMAs, measured on the real dispatches of THIS process —
+        # self-calibrating to the rig: dev-tunnel restores are slow and
+        # the model correctly prefers recompute there; TPU-VM DMA flips
+        # the break-even the other way).
+        self._prefill_rate: Optional[float] = None  # chunk tokens / s
+        self._restore_rate: Optional[float] = None  # restored pages / s
+
         # Host-DRAM offload tier: numpy slot pool + jitted page movers.
         hp = config.block_manager.host_pages
         if hp > 0:
@@ -274,7 +282,11 @@ class Engine:
             np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
             self._host_k = np.zeros(slot_shape, np_dtype)
             self._host_v = np.zeros(slot_shape, np_dtype)
-            self.block_manager.attach_host_pool(self._offload_page, self._restore_page)
+            self.block_manager.attach_host_pool(
+                self._offload_page,
+                self._restore_page,
+                self._restore_beats_recompute,
+            )
         self._pending_offloads: list = []
         self._pending_restores: list = []
         self._off_by_slot: dict = {}
@@ -316,9 +328,27 @@ class Engine:
         self._pending_restores.append((page, src))
         self._restore_by_page[page] = src
 
+    @staticmethod
+    def _ema(prev: Optional[float], sample: float, alpha: float = 0.3) -> float:
+        return sample if prev is None else (1 - alpha) * prev + alpha * sample
+
+    def _restore_beats_recompute(self, n_pages: int) -> bool:
+        """Recompute-vs-restore cost model (block-manager callback): is
+        DMA-ing ``n_pages`` host-cached pages back cheaper than
+        recomputing their ``n_pages * page_size`` tokens? Decided from
+        the online-measured rates; optimistic (restore) until both rates
+        have samples."""
+        if self._restore_rate is None or self._prefill_rate is None:
+            return True
+        restore_s = n_pages / self._restore_rate
+        recompute_s = n_pages * self.page_size / self._prefill_rate
+        return restore_s <= recompute_s
+
     def _flush_page_moves(self) -> None:
         if not self._pending_offloads and not self._pending_restores:
             return
+        n_restores = len({p for p, _ in self._pending_restores})
+        t0 = time.perf_counter() if n_restores else 0.0
         # One batched gather for every device page any queued move reads.
         need = []
         for _, src in self._pending_offloads + self._pending_restores:
@@ -359,6 +389,13 @@ class Engine:
             )
             self.v_pages = _write_pages_batch(
                 self.v_pages, idx, jnp.asarray(v_stack)
+            )
+            # Fence with a scalar fetch (block_until_ready is lazy on the
+            # tunnel) so the restore-rate sample covers the real DMA.
+            np.asarray(self.k_pages[0, 0, 0, 0, 0])
+            self._restore_rate = self._ema(
+                self._restore_rate,
+                n_restores / max(time.perf_counter() - t0, 1e-6),
             )
 
         self._pending_offloads.clear()
@@ -477,6 +514,7 @@ class Engine:
         # land before attention reads; spilled pages must be snapshotted
         # before this prefill overwrites them).
         self._flush_page_moves()
+        t0 = time.perf_counter()
         logits, self.k_pages, self.v_pages = llama.prefill(
             self.params,
             self.model_cfg,
@@ -492,7 +530,13 @@ class Engine:
             mesh=self.mesh,
             attn_impl=self.prefill_attn,
         )
-        first_tokens = self._sample(logits, seqs)
+        first_tokens = self._sample(logits, seqs)  # syncs the dispatch
+        # Online prefill-rate sample for the recompute-vs-restore model
+        # (chunk tokens over the synced dispatch wall time).
+        self._prefill_rate = self._ema(
+            self._prefill_rate,
+            float(valid.sum()) / max(time.perf_counter() - t0, 1e-6),
+        )
         now = time.monotonic()
         # Admit to running BEFORE appending slots: batchmates must be
         # preemption candidates if page growth exhausts the pool here.
@@ -964,12 +1008,49 @@ class Engine:
         """Grow ``seq`` by one slot, preempting on pool exhaustion."""
         self._grow_or_preempt(seq, lambda: self.block_manager.append_slot(seq))
 
+    def _bring_back_cost_s(self, cand: Sequence) -> float:
+        """Modeled cost of preempting ``cand`` and bringing it back later:
+        registered pages survive in the prefix cache or spill to the
+        host tier (per-page cost = the cheaper of restore DMA and
+        recompute), unregistered tokens are pure recompute."""
+        reg_pages = cand.num_registered_pages
+        fresh_toks = max(cand.num_tokens - reg_pages * self.page_size, 0)
+        per_page_recompute = self.page_size / self._prefill_rate
+        per_page = (
+            min(1.0 / self._restore_rate, per_page_recompute)
+            if self._restore_rate
+            else per_page_recompute
+        )
+        return fresh_toks / self._prefill_rate + reg_pages * per_page
+
+    def _pick_victim(self, seq: Sequence) -> Optional[Sequence]:
+        """Preemption victim policy. Recency (most recently admitted) by
+        default; with the host tier attached and rates measured, the
+        candidate with the LOWEST modeled bring-back cost
+        (recompute-vs-restore aware) wins, recency breaking ties.
+        Never picks sequences that are done generating (they finish right
+        after the caller's loop) — re-prefilling one would emit an extra
+        token beyond its max_new_tokens contract."""
+        candidates = [
+            cand
+            for cand in reversed(self.scheduler.running)
+            if cand is not seq and not self._should_finish(cand)
+        ]
+        if not candidates:
+            return None
+        if (
+            self.config.block_manager.host_pages > 0
+            and self._prefill_rate is not None
+        ):
+            return min(candidates, key=self._bring_back_cost_s)
+        return candidates[0]
+
     def _grow_or_preempt(self, seq: Sequence, grow) -> None:
-        """Run ``grow()``; on pool exhaustion, preempt the most recently
-        admitted *other* running sequence (recompute-style: its pages are
-        freed — surviving cached pages make its later re-prefill cheap —
-        and it requeues). When nothing is left to reclaim, aborts ``seq``
-        rather than wedging the engine."""
+        """Run ``grow()``; on pool exhaustion, preempt another running
+        sequence (recompute-style: its pages are freed — surviving cached
+        pages make its later re-prefill cheap — and it requeues); victim
+        per ``_pick_victim``. When nothing is left to reclaim, aborts
+        ``seq`` rather than wedging the engine."""
         from .block_manager import AllocationError
 
         while True:
@@ -977,14 +1058,7 @@ class Engine:
                 grow()
                 return
             except AllocationError:
-                victim = None
-                for cand in reversed(self.scheduler.running):
-                    # Never preempt sequences that are done generating (they
-                    # finish right after this loop) — re-prefilling one would
-                    # emit an extra token beyond its max_new_tokens contract.
-                    if cand is not seq and not self._should_finish(cand):
-                        victim = cand
-                        break
+                victim = self._pick_victim(seq)
                 if victim is None:
                     # Nothing left to reclaim: the pool cannot hold even this
                     # one sequence. Abort the request rather than wedging the

@@ -616,6 +616,52 @@ class TestHostDramOffloadTier:
         assert outs == ref_outs
         assert s.num_cached_prompt > 0  # repeat of A hit the restored pages
 
+    def test_restore_declined_when_recompute_is_cheaper(self):
+        # Recompute-vs-restore cost model: with measured rates that make
+        # the restore DMA lose (slow tier, fast prefill), a prefix hit on
+        # the host tier must be DECLINED — same tokens, zero restores.
+        prompts = [_prompt(40 + i, 16) for i in range(3)]
+
+        def run(force_slow_restore):
+            eng = _engine(total_pages=12, host_pages=32)
+            outs = []
+            for p in prompts + [prompts[0]]:
+                if force_slow_restore:
+                    # Pin the EMAs: restoring one page "takes" 1000x the
+                    # recompute of its tokens.
+                    eng._prefill_rate = 1e9
+                    eng._restore_rate = 1e-3
+                s = eng.add_request(p, SamplingParams(max_new_tokens=5))
+                eng.run_until_complete()
+                outs.append(s.output_tokens)
+            return eng, s, outs
+
+        ref_eng, ref_last, ref_outs = run(force_slow_restore=False)
+        assert ref_last.num_cached_prompt > 0  # baseline DID restore
+        eng, last, outs = run(force_slow_restore=True)
+        assert outs == ref_outs  # recompute path is exact
+        assert last.num_cached_prompt == 0  # ...but nothing was restored
+
+    def test_victim_choice_minimizes_bring_back_cost(self):
+        # With the tier on and rates pinned so restores are ~free, the
+        # preemption victim should be the sequence whose pages are
+        # REGISTERED (restorable) — not the most recent one.
+        eng = _engine(total_pages=14, host_pages=32, decode_batch=2)
+        a = eng.add_request(_prompt(1, 30), SamplingParams(max_new_tokens=40))
+        eng.step()  # prefill A; its prompt pages register
+        b = eng.add_request(_prompt(2, 9), SamplingParams(max_new_tokens=40))
+        eng.step()  # prefill B (fits in the remaining pages)
+        assert a.num_registered_pages > b.num_registered_pages
+        eng._prefill_rate = 100.0
+        eng._restore_rate = 1e9  # restores ~free -> registered seq is cheap
+        victim = eng._pick_victim(b)
+        assert victim is a
+        # And with no tier data the policy stays recency (most recent
+        # other sequence).
+        eng._restore_rate = None
+        eng._prefill_rate = None
+        assert eng._pick_victim(a) is b
+
     def test_fused_decode_spill_snapshots_before_overwrite(self):
         """Regression for the batched-mover ordering hazard: during FUSED
         decode, burst reservation can preempt a victim and recycle its
