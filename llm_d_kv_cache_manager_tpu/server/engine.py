@@ -168,7 +168,13 @@ class Engine:
         config: EngineConfig,
         params=None,
         on_events: Optional[Callable[[list[Event]], None]] = None,
+        mesh=None,
     ):
+        """``mesh``: optional pre-built (dp=1, sp, tp) Mesh whose axis
+        sizes match the config — lets a multi-replica host place each
+        engine on its OWN device slice (e.g. two tp=2 pods on a 4-device
+        mesh; the fleet dryrun and multi-pod-per-host deployments).
+        Default: a mesh over the first sp*tp visible devices."""
         self.config = config
         cfg = config.model
         self.model_cfg = cfg
@@ -254,9 +260,20 @@ class Engine:
             from ..parallel import MeshConfig, make_mesh, shard_params
             from ..parallel.sharding import kv_pages_sharding
 
-            self.mesh = make_mesh(
-                MeshConfig(dp=1, sp=config.sp, tp=config.tp)
-            )
+            if mesh is not None:
+                if (
+                    mesh.shape.get("sp", 1) != config.sp
+                    or mesh.shape.get("tp", 1) != config.tp
+                ):
+                    raise ValueError(
+                        f"provided mesh {dict(mesh.shape)} does not match "
+                        f"config sp={config.sp}, tp={config.tp}"
+                    )
+                self.mesh = mesh
+            else:
+                self.mesh = make_mesh(
+                    MeshConfig(dp=1, sp=config.sp, tp=config.tp)
+                )
             params = shard_params(params, self.mesh, cfg)
         self.params = params
         self.k_pages, self.v_pages = llama.init_kv_pages(
