@@ -67,6 +67,13 @@ assert "kv-cache-scoring-events" in env_text, "fleet does not point at the event
 print(f"ok: {len(docs)} k8s objects across {len(set(p for p, _ in docs))} files")
 EOF
 
+echo "== [1a/3] kustomize build + schema/cross-ref validation =="
+# Rendered-output validation (kustomize_lite implements the exact feature
+# subset deploy/ uses; no kustomize/kubeconform binary in this image):
+# generators resolve, namespaces/selectors/serviceName/configMapRefs all
+# cross-check post-render — the drift class a python-yaml lint can't see.
+python tests/kustomize_lite.py deploy deploy/overlays/*/
+
 echo "== [1b/3] values.env tunables-surface contract =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_deploy_config.py -q
 

@@ -1,0 +1,270 @@
+"""Minimal kustomize build + schema validation for `deploy/`.
+
+The reference smoke-tests its full stack against a real API server
+(`/root/reference/tests/kind-vllm-cpu.sh:22-80`); this image has neither
+kind nor the kustomize/kubeconform binaries, so this module implements the
+EXACT feature subset our kustomizations use — `resources` (files and
+nested kustomization dirs), `namespace`, `configMapGenerator`
+(`envs`, `behavior: create|replace`, `disableNameSuffixHash`), and
+`replicas` — then validates the rendered objects the way kubeconform +
+an apply dry-run would catch drift:
+
+- minimal per-kind schema shapes (apiVersion/kind/metadata.name, selector
+  vs template labels, ports, container basics);
+- cross-references: every `envFrom.configMapRef` resolves to a rendered
+  ConfigMap, StatefulSet `serviceName` resolves to a headless Service,
+  Service selectors match some workload's pod labels, `replicas`
+  overrides name an existing workload;
+- generator contract: `behavior: replace` must replace a map the base
+  actually generates, env files must exist and parse.
+
+Real kustomize remains the authority; any feature outside the subset
+fails loudly here rather than silently rendering wrong.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import yaml
+
+
+class KustomizeError(ValueError):
+    pass
+
+
+_SUPPORTED_KEYS = {
+    "apiVersion", "kind", "namespace", "resources", "configMapGenerator",
+    "replicas",
+}
+_SUPPORTED_GEN_KEYS = {"name", "behavior", "envs", "options"}
+#: cluster-scoped kinds never get the kustomization namespace
+_CLUSTER_SCOPED = {"Namespace"}
+
+
+def _load_env_file(path: pathlib.Path) -> dict[str, str]:
+    if not path.exists():
+        raise KustomizeError(f"configMapGenerator env file missing: {path}")
+    out: dict[str, str] = {}
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            if "=" not in line:
+                raise KustomizeError(f"{path}: malformed env line {line!r}")
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def build(dir_path: str | pathlib.Path) -> list[dict]:
+    """Render a kustomization directory to a list of manifest objects."""
+    root = pathlib.Path(dir_path)
+    kfile = root / "kustomization.yaml"
+    if not kfile.exists():
+        raise KustomizeError(f"no kustomization.yaml in {root}")
+    kust = yaml.safe_load(kfile.read_text()) or {}
+
+    unknown = set(kust) - _SUPPORTED_KEYS
+    if unknown:
+        raise KustomizeError(
+            f"{kfile}: unsupported kustomize features {sorted(unknown)} — "
+            "extend kustomize_lite or validate with real kustomize"
+        )
+
+    docs: list[dict] = []
+    for res in kust.get("resources", []):
+        p = (root / res).resolve()
+        if p.is_dir():
+            docs.extend(build(p))
+        else:
+            for doc in yaml.safe_load_all(p.read_text()):
+                if doc:
+                    docs.append(doc)
+
+    for gen in kust.get("configMapGenerator", []):
+        unknown = set(gen) - _SUPPORTED_GEN_KEYS
+        if unknown:
+            raise KustomizeError(
+                f"{kfile}: unsupported configMapGenerator keys "
+                f"{sorted(unknown)}"
+            )
+        if not (gen.get("options") or {}).get("disableNameSuffixHash"):
+            raise KustomizeError(
+                f"{kfile}: configMapGenerator without "
+                "disableNameSuffixHash — the lite builder does not "
+                "implement suffix hashing"
+            )
+        data: dict[str, str] = {}
+        for env in gen.get("envs", []):
+            data.update(_load_env_file(root / env))
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": gen["name"]},
+            "data": data,
+        }
+        behavior = gen.get("behavior", "create")
+        existing = [
+            i
+            for i, d in enumerate(docs)
+            if d.get("kind") == "ConfigMap"
+            and d["metadata"]["name"] == gen["name"]
+        ]
+        if behavior == "replace":
+            if not existing:
+                raise KustomizeError(
+                    f"{kfile}: behavior=replace but no base generates "
+                    f"ConfigMap/{gen['name']}"
+                )
+            for i in existing:
+                docs[i] = cm
+        elif behavior == "create":
+            if existing:
+                raise KustomizeError(
+                    f"{kfile}: ConfigMap/{gen['name']} already exists "
+                    "(use behavior: replace)"
+                )
+            docs.append(cm)
+        else:
+            raise KustomizeError(f"{kfile}: unsupported behavior {behavior!r}")
+
+    ns = kust.get("namespace")
+    if ns:
+        for doc in docs:
+            if doc.get("kind") not in _CLUSTER_SCOPED:
+                doc.setdefault("metadata", {})["namespace"] = ns
+
+    for override in kust.get("replicas", []):
+        matched = False
+        for doc in docs:
+            if (
+                doc.get("kind") in ("StatefulSet", "Deployment")
+                and doc["metadata"]["name"] == override["name"]
+            ):
+                doc["spec"]["replicas"] = override["count"]
+                matched = True
+        if not matched:
+            raise KustomizeError(
+                f"{kfile}: replicas override targets unknown workload "
+                f"{override['name']!r}"
+            )
+
+    # Duplicate identity check (same kind+ns+name twice = apply conflict).
+    seen: set[tuple] = set()
+    for doc in docs:
+        ident = (
+            doc.get("kind"),
+            (doc.get("metadata") or {}).get("namespace"),
+            (doc.get("metadata") or {}).get("name"),
+        )
+        if ident in seen:
+            raise KustomizeError(f"duplicate object {ident}")
+        seen.add(ident)
+    return docs
+
+
+def _containers(doc: dict) -> list[dict]:
+    return (
+        doc.get("spec", {})
+        .get("template", {})
+        .get("spec", {})
+        .get("containers", [])
+    )
+
+
+def validate(docs: list[dict]) -> None:
+    """Schema-shape + cross-reference validation of rendered objects."""
+    by_kind: dict[str, list[dict]] = {}
+    for doc in docs:
+        for key in ("apiVersion", "kind"):
+            if not doc.get(key):
+                raise KustomizeError(f"object missing {key}: {doc}")
+        if not (doc.get("metadata") or {}).get("name"):
+            raise KustomizeError(f"object missing metadata.name: {doc}")
+        by_kind.setdefault(doc["kind"], []).append(doc)
+
+    def names(kind):
+        return {d["metadata"]["name"] for d in by_kind.get(kind, [])}
+
+    # Namespaced objects must land in a namespace the build creates.
+    created_ns = names("Namespace")
+    for doc in docs:
+        if doc["kind"] in _CLUSTER_SCOPED:
+            continue
+        ns = doc["metadata"].get("namespace")
+        if ns and created_ns and ns not in created_ns:
+            raise KustomizeError(
+                f"{doc['kind']}/{doc['metadata']['name']} targets namespace "
+                f"{ns!r} which the build does not create"
+            )
+
+    workloads = by_kind.get("StatefulSet", []) + by_kind.get("Deployment", [])
+    pod_label_sets = []
+    for wl in workloads:
+        name = f"{wl['kind']}/{wl['metadata']['name']}"
+        spec = wl.get("spec", {})
+        tmpl_labels = (
+            spec.get("template", {}).get("metadata", {}).get("labels", {})
+        )
+        pod_label_sets.append(tmpl_labels)
+        sel = spec.get("selector", {}).get("matchLabels", {})
+        if not sel:
+            raise KustomizeError(f"{name}: missing selector.matchLabels")
+        if any(tmpl_labels.get(k) != v for k, v in sel.items()):
+            raise KustomizeError(
+                f"{name}: selector {sel} does not match template labels "
+                f"{tmpl_labels}"
+            )
+        if not _containers(wl):
+            raise KustomizeError(f"{name}: no containers")
+        for c in _containers(wl):
+            if not c.get("image"):
+                raise KustomizeError(f"{name}: container without image")
+            for ef in c.get("envFrom", []):
+                ref = (ef.get("configMapRef") or {}).get("name")
+                if ref and ref not in names("ConfigMap"):
+                    raise KustomizeError(
+                        f"{name}: envFrom references ConfigMap {ref!r} "
+                        "which the build does not render"
+                    )
+        if wl["kind"] == "StatefulSet":
+            svc = spec.get("serviceName")
+            if svc and svc not in names("Service"):
+                raise KustomizeError(
+                    f"{name}: serviceName {svc!r} has no rendered Service"
+                )
+
+    for svc in by_kind.get("Service", []):
+        sel = svc.get("spec", {}).get("selector")
+        if sel and not any(
+            all(labels.get(k) == v for k, v in sel.items())
+            for labels in pod_label_sets
+        ):
+            raise KustomizeError(
+                f"Service/{svc['metadata']['name']}: selector {sel} matches "
+                "no workload's pod labels"
+            )
+        if not svc.get("spec", {}).get("ports"):
+            raise KustomizeError(
+                f"Service/{svc['metadata']['name']}: no ports"
+            )
+
+    for cm in by_kind.get("ConfigMap", []):
+        if not cm.get("data"):
+            raise KustomizeError(
+                f"ConfigMap/{cm['metadata']['name']}: empty data"
+            )
+
+
+def build_and_validate(dir_path: str | pathlib.Path) -> list[dict]:
+    docs = build(dir_path)
+    validate(docs)
+    return docs
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI for fleet_smoke.sh
+    import sys
+
+    for d in sys.argv[1:]:
+        rendered = build_and_validate(d)
+        print(f"{d}: {len(rendered)} objects OK")

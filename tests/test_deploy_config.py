@@ -77,6 +77,78 @@ def test_kustomizations_generate_the_shared_map_from_values_env():
     assert gens >= 3  # base + both overlays
 
 
+def test_kustomize_build_renders_and_cross_validates():
+    """Render base + every overlay through the lite builder and validate
+    the OUTPUT (generator resolution, namespace placement, selector /
+    serviceName / configMapRef cross-references) — the manifest-drift
+    class a source-file lint can't see (ref analogue: the kind apply in
+    `tests/kind-vllm-cpu.sh`)."""
+    import kustomize_lite
+
+    overlays = sorted((DEPLOY / "overlays").iterdir())
+    assert overlays
+    for target in [DEPLOY] + overlays:
+        docs = kustomize_lite.build_and_validate(target)
+        kinds = {d["kind"] for d in docs}
+        assert "ConfigMap" in kinds, f"{target}: no generated ConfigMap"
+        if "overlays" in str(target):
+            # Overlays must render the full stack: fleet + scoring + ns.
+            assert {"StatefulSet", "Deployment", "Service", "Namespace"} <= kinds
+            sts = next(d for d in docs if d["kind"] == "StatefulSet")
+            # The overlay's replica count (not the checked-in default).
+            kust = yaml.safe_load((target / "kustomization.yaml").read_text())
+            want = next(
+                r["count"]
+                for r in kust["replicas"]
+                if r["name"] == sts["metadata"]["name"]
+            )
+            assert sts["spec"]["replicas"] == want
+            cm = next(d for d in docs if d["kind"] == "ConfigMap")
+            # behavior: replace swapped in the overlay's values.env.
+            overlay_keys = set(_env_keys(target / "values.env"))
+            assert set(cm["data"]) == overlay_keys
+
+
+def test_kustomize_lite_catches_drift(tmp_path):
+    """The validator must FAIL on the drift it exists to catch — broken
+    configMapRef, replicas override naming nothing, selector mismatch."""
+    import copy
+
+    import pytest
+
+    import kustomize_lite
+
+    good = kustomize_lite.build_and_validate(DEPLOY / "overlays" / "llama3-8b-int8-tp8")
+
+    # envFrom pointing at a ConfigMap the build doesn't render.
+    broken = copy.deepcopy(good)
+    for d in broken:
+        if d["kind"] == "StatefulSet":
+            d["spec"]["template"]["spec"]["containers"][0]["envFrom"][0][
+                "configMapRef"
+            ]["name"] = "no-such-map"
+    with pytest.raises(kustomize_lite.KustomizeError, match="no-such-map"):
+        kustomize_lite.validate(broken)
+
+    # selector no longer matching pod labels.
+    broken = copy.deepcopy(good)
+    for d in broken:
+        if d["kind"] == "Deployment":
+            d["spec"]["selector"]["matchLabels"]["app"] = "typo"
+    with pytest.raises(kustomize_lite.KustomizeError, match="selector"):
+        kustomize_lite.validate(broken)
+
+    # replicas override targeting a workload that doesn't exist.
+    overlay = tmp_path / "bad"
+    overlay.mkdir()
+    (overlay / "kustomization.yaml").write_text(
+        "resources: [" + str(DEPLOY / "tpu-serving") + "]\n"
+        "replicas: [{name: nope, count: 2}]\n"
+    )
+    with pytest.raises(kustomize_lite.KustomizeError, match="nope"):
+        kustomize_lite.build(overlay)
+
+
 def test_declared_keys_are_consumed_by_server_env_readers():
     src = "".join(
         p.read_text() for p in SERVER_SRC.glob("*.py")
