@@ -1,0 +1,215 @@
+"""Chat-templating overhead: /score_chat_completions vs /score_completions.
+
+The reference quantifies its chat-preprocessing tax end to end
+(`pkg/preprocessing/chat_completions/README.md:118-132`: +10 % TTFT,
++14 % ITL on Qwen2.5-0.5B). Our service is the SCORING side, so the
+honest analogue is scoring-request latency through `server/api.py`: the
+chat endpoint pays template fetch + Jinja render on top of the shared
+tokenize→hash→score path, and this bench measures that delta through the
+real HTTP stack (aiohttp test server, real Rust `tokenizers` core with a
+corpus-derived WordPiece vocab — no network).
+
+Reports p50/p90/mean per endpoint, the chat delta, and the cold-template
+(first-render Jinja compile) cost. Writes a markdown row you can paste
+into benchmarking/results/chat_overhead.md and prints one JSON line.
+
+Run: python benchmarking/bench_chat_overhead.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+MODEL = "bench/chat-model"
+
+LLAMA3_STYLE_TPL = (
+    "{{ bos_token }}{% for message in messages %}"
+    "<|start_header_id|>{{ message['role'] }}<|end_header_id|>\n\n"
+    "{{ message['content'] }}<|eot_id|>{% endfor %}"
+    "{% if add_generation_prompt %}"
+    "<|start_header_id|>assistant<|end_header_id|>\n\n{% endif %}"
+)
+
+WORDS = (
+    "the quick brown fox jumps over a lazy dog while seventeen engineers "
+    "benchmark kv cache aware routing on tpu pods measuring latency "
+    "percentiles under shared prefix load with chat templates rendered "
+    "for every scoring request in the fleet"
+).split()
+
+
+def make_rust_tokenizer():
+    """Real Rust `tokenizers` core, WordPiece vocab derived from the
+    corpus (offline — same approach as tests/test_tokenizer_offsets.py)."""
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"[UNK]": 0}
+    for w in WORDS + ["<|start_header_id|>", "<|end_header_id|>", "<|eot_id|>",
+                      "<|begin_of_text|>", "system", "user", "assistant"]:
+        vocab.setdefault(w, len(vocab))
+        # Cover mid-word pieces so nothing degenerates to [UNK].
+        for i in range(1, len(w)):
+            vocab.setdefault("##" + w[i:], len(vocab))
+            vocab.setdefault(w[:i], len(vocab))
+    tok = Tokenizer(models.WordPiece(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    return tok
+
+
+class RustCoreTokenizer:
+    """Adapter: handmade Rust-core tokenizer behind the service's
+    Tokenizer interface (ids + byte offsets, like CachedHFTokenizer)."""
+
+    def __init__(self):
+        self._tok = make_rust_tokenizer()
+
+    def encode(self, prompt: str, model_name: str):
+        from llm_d_kv_cache_manager_tpu.tokenization.tokenizer import (
+            char_offsets_to_byte_offsets,
+        )
+
+        enc = self._tok.encode(prompt)
+        return list(enc.ids), char_offsets_to_byte_offsets(prompt, enc.offsets)
+
+
+def build_conversation(rng, n_messages: int, words_per_msg: int):
+    msgs = [{"role": "system", "content": "You are a scoring benchmark."}]
+    for i in range(n_messages):
+        msgs.append(
+            {
+                "role": "user" if i % 2 == 0 else "assistant",
+                "content": " ".join(rng.choice(WORDS, words_per_msg)),
+            }
+        )
+    return msgs
+
+
+async def timed_post(client, path, payload, reps, lat_ms):
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        resp = await client.post(path, json=payload)
+        assert resp.status == 200, (path, resp.status, await resp.text())
+        await resp.json()
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+
+def main() -> int:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_d_kv_cache_manager_tpu.server.api import (
+        ScoringService,
+        ServiceConfig,
+    )
+
+    reps = int(os.environ.get("BENCH_CHAT_REPS", "300"))
+    n_messages = int(os.environ.get("BENCH_CHAT_MESSAGES", "8"))
+    words_per_msg = int(os.environ.get("BENCH_CHAT_WORDS", "40"))
+
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    service = ScoringService(
+        ServiceConfig(block_size=16, zmq_endpoint=f"tcp://*:{port}"),
+        tokenizer=RustCoreTokenizer(),
+    )
+    service.start()
+
+    rng = np.random.default_rng(7)
+    convo = build_conversation(rng, n_messages, words_per_msg)
+    # The completions comparator scores the SAME rendered text, so the
+    # tokenize+hash+score work is identical and the delta isolates the
+    # chat-only stages (request shape + template fetch/render).
+    from llm_d_kv_cache_manager_tpu.preprocessing.chat_completions import (
+        ChatTemplatingProcessor,
+        RenderRequest,
+    )
+
+    proc = ChatTemplatingProcessor()
+    proc.initialize()
+    rendered = proc.render_chat_template(
+        RenderRequest(
+            conversations=[convo],
+            chat_template=LLAMA3_STYLE_TPL,
+            template_vars={"bos_token": "<|begin_of_text|>"},
+        )
+    ).rendered_chats[0]
+    proc.finalize()
+
+    completions_payload = {"prompt": rendered, "model": MODEL}
+    chat_payload = {
+        "messages": convo,
+        "model": MODEL,
+        "chat_template": LLAMA3_STYLE_TPL,
+        "chat_template_kwargs": {"bos_token": "<|begin_of_text|>"},
+    }
+
+    out = {}
+
+    async def runner():
+        server = TestServer(service.build_app())
+        client = TestClient(server)
+        await client.start_server()
+        try:
+            # Cold-template cost: the very first chat render (Jinja
+            # compile + template-cache miss).
+            cold = []
+            await timed_post(
+                client, "/score_chat_completions", chat_payload, 1, cold
+            )
+            out["chat_cold_first_ms"] = round(cold[0], 3)
+
+            # Interleave warm measurement batches to keep drift fair.
+            comp, chat = [], []
+            half = reps // 2
+            await timed_post(client, "/score_completions", completions_payload, 20, [])
+            await timed_post(client, "/score_chat_completions", chat_payload, 20, [])
+            await timed_post(client, "/score_completions", completions_payload, half, comp)
+            await timed_post(client, "/score_chat_completions", chat_payload, half, chat)
+            await timed_post(client, "/score_completions", completions_payload, reps - half, comp)
+            await timed_post(client, "/score_chat_completions", chat_payload, reps - half, chat)
+
+            for name, lat in (("completions", comp), ("chat", chat)):
+                arr = np.asarray(lat)
+                out[name] = {
+                    "p50_ms": round(float(np.median(arr)), 3),
+                    "p90_ms": round(float(np.percentile(arr, 90)), 3),
+                    "mean_ms": round(float(np.mean(arr)), 3),
+                    "n": len(lat),
+                }
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(runner())
+    finally:
+        service.shutdown()
+
+    c, ch = out["completions"], out["chat"]
+    out["chat_overhead_pct"] = {
+        "p50": round(100.0 * (ch["p50_ms"] - c["p50_ms"]) / c["p50_ms"], 1),
+        "p90": round(100.0 * (ch["p90_ms"] - c["p90_ms"]) / c["p90_ms"], 1),
+        "mean": round(100.0 * (ch["mean_ms"] - c["mean_ms"]) / c["mean_ms"], 1),
+    }
+    out["config"] = {
+        "reps": reps,
+        "messages": n_messages,
+        "words_per_msg": words_per_msg,
+        "rendered_chars": len(rendered),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
