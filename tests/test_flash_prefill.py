@@ -45,16 +45,18 @@ def _compare(q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid, atol=2e
         q, k, v, k_pages, v_pages, block_tables, ctx_lens,
         positions=positions, valid=valid,
     )
-    got = flash_prefill_paged(
-        q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid,
-        interpret=True,
-    )
     # Only valid query rows are meaningful (the engine reads nothing else;
     # the kernel zeroes them, the oracle attends context from them).
     mask = np.asarray(valid)[:, :, None, None]
-    np.testing.assert_allclose(
-        np.asarray(got) * mask, np.asarray(ref) * mask, atol=atol, rtol=1e-4
-    )
+    for ctx_mode in ("gather", "dma"):
+        got = flash_prefill_paged(
+            q, k, v, k_pages, v_pages, block_tables, ctx_lens, n_valid,
+            interpret=True, ctx_mode=ctx_mode,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got) * mask, np.asarray(ref) * mask, atol=atol,
+            rtol=1e-4, err_msg=f"ctx_mode={ctx_mode}",
+        )
 
 
 class TestFlashPrefillParity:
