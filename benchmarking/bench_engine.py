@@ -231,32 +231,42 @@ def main() -> int:
             )
 
         cfg_base = replace(cfg, decode_steps_per_iter=1)
-        cfg_spec = replace(
-            cfg, decode_steps_per_iter=1, spec_decode="prompt_lookup",
-            spec_k=4, spec_ngram=3,
-        )
         spec_round(cfg_base)  # compile
         base_tps, _ = spec_round(cfg_base)
-        spec_round(cfg_spec)  # compile verify shapes
-        spec_tps, stats = spec_round(cfg_spec)
-        acc = stats["accepted"] / max(stats["proposed"], 1)
-        print(
-            json.dumps(
-                {
-                    "metric": "decode_throughput_spec",
-                    "value": round(spec_tps, 1),
-                    "unit": "tok/s",
-                    "model": mode,
-                    "decode_batch": spec_batch,
-                    "workload": "repetitive",
-                    "plain_same_workload": round(base_tps, 1),
-                    "vs_plain": round(spec_tps / max(base_tps, 1e-9), 3),
-                    "acceptance_rate": round(acc, 3),
-                    "verify_steps": stats["verify_steps"],
-                    "backend": jax.default_backend(),
-                }
+        # spec_rounds sweep: 1 = the classic one-verify-per-dispatch loop;
+        # >1 = fused rounds chained on device (llama.spec_decode_steps),
+        # paying one host sync per N verifies.
+        rounds_list = [
+            int(r)
+            for r in os.environ.get("BENCH_SPEC_ROUNDS", "1,4").split(",")
+        ]
+        for rounds in rounds_list:
+            cfg_spec = replace(
+                cfg, decode_steps_per_iter=1, spec_decode="prompt_lookup",
+                spec_k=4, spec_ngram=3, spec_rounds=rounds,
             )
-        )
+            spec_round(cfg_spec)  # compile verify shapes
+            spec_tps, stats = spec_round(cfg_spec)
+            acc = stats["accepted"] / max(stats["proposed"], 1)
+            print(
+                json.dumps(
+                    {
+                        "metric": "decode_throughput_spec",
+                        "value": round(spec_tps, 1),
+                        "unit": "tok/s",
+                        "model": mode,
+                        "decode_batch": spec_batch,
+                        "workload": "repetitive",
+                        "spec_rounds": rounds,
+                        "plain_same_workload": round(base_tps, 1),
+                        "vs_plain": round(spec_tps / max(base_tps, 1e-9), 3),
+                        "acceptance_rate": round(acc, 3),
+                        "verify_steps": stats["verify_steps"],
+                        "bursts": stats["bursts"],
+                        "backend": jax.default_backend(),
+                    }
+                )
+            )
     return 0
 
 
