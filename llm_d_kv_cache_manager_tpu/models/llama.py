@@ -1191,8 +1191,11 @@ def spec_decode_steps(
     its last position (emitting nothing) — wasted-but-safe, like finished
     lanes inside a fused burst.
 
-    Returns ``(emit [rounds, b, spec_k+1], emit_len [rounds, b],
-    prop_len [rounds, b], acc [rounds, b], k_pages, v_pages)``.
+    Returns ``(packed [rounds, b, spec_k+4] int32, k_pages, v_pages)``
+    where ``packed[..., :k+1]`` are the emitted tokens and
+    ``packed[..., k+1:k+4]`` are (emit_len, prop_len, accepted) — ONE
+    array so the burst costs a single blocking device→host fetch (four
+    separate fetches measurably serialized on high-latency links).
     """
     b, W = window.shape
     n = ngram
@@ -1328,4 +1331,8 @@ def spec_decode_steps(
             keys,
         )
     )
-    return emit, emit_len, prop_len, acc, k_pages, v_pages
+    packed = jnp.concatenate(
+        [emit, emit_len[..., None], prop_len[..., None], acc[..., None]],
+        axis=-1,
+    )
+    return packed, k_pages, v_pages

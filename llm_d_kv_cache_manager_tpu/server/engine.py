@@ -930,7 +930,7 @@ class Engine:
             # leave the engine rng untouched (sampled streams elsewhere in
             # the run must not shift because a greedy lane speculated).
             key = jax.random.PRNGKey(0)
-        emit, emit_len, prop_len, acc, self.k_pages, self.v_pages = (
+        packed, self.k_pages, self.v_pages = (
             llama.spec_decode_steps(
                 self.params,
                 self.model_cfg,
@@ -956,11 +956,14 @@ class Engine:
                 attn_impl=self.prefill_attn,
             )
         )
-        # The one host sync of the burst.
-        emit = np.asarray(emit)  # [rounds, b, k+1]
-        emit_len = np.asarray(emit_len)  # [rounds, b]
-        prop_len = np.asarray(prop_len)
-        acc = np.asarray(acc)
+        # The one host sync of the burst: ONE packed fetch (emit tokens +
+        # per-round counters in a single array — separate fetches would
+        # serialize several blocking round-trips on high-latency links).
+        packed = np.asarray(packed)  # [rounds, b, k+4]
+        emit = packed[..., : k + 1]
+        emit_len = packed[..., k + 1]
+        prop_len = packed[..., k + 2]
+        acc = packed[..., k + 3]
 
         self.spec_stats["verify_steps"] += rounds
         self.spec_stats["bursts"] += 1
