@@ -132,6 +132,15 @@ class BlockManager:
             or info.chain_hash in self._host_cached
         ):
             return
+        # A spill only ever pays off as a later restore; when the cost
+        # model says restoring loses to recompute on this link, the
+        # device→host copy is pure waste — skip it (measured: under
+        # thrash, ungated spills alone collapse throughput ~15× on the
+        # dev tunnel even with every restore declined, results/
+        # tiering.md round 5). Optimistic until both rates have samples,
+        # so the model can bootstrap from real early spills+restores.
+        if self._restore_policy is not None and not self._restore_policy(1):
+            return
         slot = self._host_alloc_slot()
         if slot is None:
             return

@@ -147,6 +147,11 @@ class EngineConfig:
     #: closed for that sequence (sequences are short-lived).
     spec_min_accept: float = 0.4
     spec_min_sample: int = 8
+    #: host-DRAM tier admission: "auto" (recompute-vs-restore cost model
+    #: from online-measured rates gates BOTH spills and restores — the
+    #: self-calibrating default) or "always" (unconditional spill/restore;
+    #: use when the link is known-good and warm-up declines are unwanted).
+    host_tier_policy: str = "auto"
     #: weight quantization: None (serve in model dtype) or "int8"
     #: (symmetric per-output-channel weight-only int8 — halves weight HBM
     #: bytes so 8B-class models fit one v5e chip with a KV pool;
@@ -291,6 +296,7 @@ class Engine:
         # the break-even the other way).
         self._prefill_rate: Optional[float] = None  # chunk tokens / s
         self._restore_rate: Optional[float] = None  # restored pages / s
+        self._offload_rate: Optional[float] = None  # D2H gathered pages / s
 
         # Host-DRAM offload tier: numpy slot pool + jitted page movers.
         hp = config.block_manager.host_pages
@@ -299,10 +305,16 @@ class Engine:
             np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
             self._host_k = np.zeros(slot_shape, np_dtype)
             self._host_v = np.zeros(slot_shape, np_dtype)
+            if config.host_tier_policy not in ("auto", "always"):
+                raise ValueError(
+                    f"unknown host_tier_policy {config.host_tier_policy!r}"
+                )
             self.block_manager.attach_host_pool(
                 self._offload_page,
                 self._restore_page,
-                self._restore_beats_recompute,
+                self._restore_beats_recompute
+                if config.host_tier_policy == "auto"
+                else None,
             )
         self._pending_offloads: list = []
         self._pending_restores: list = []
@@ -353,11 +365,20 @@ class Engine:
         """Recompute-vs-restore cost model (block-manager callback): is
         DMA-ing ``n_pages`` host-cached pages back cheaper than
         recomputing their ``n_pages * page_size`` tokens? Decided from
-        the online-measured rates; optimistic (restore) until both rates
-        have samples."""
-        if self._restore_rate is None or self._prefill_rate is None:
+        the online-measured rates. Until a restore has been measured, the
+        offload (D2H gather) rate stands in as the link-bandwidth proxy —
+        it exists from the FIRST spill flush, which closes the bootstrap
+        hole where spills run ungated (and at dev-tunnel bandwidth,
+        ruinously) before any restore ever produced a sample. Optimistic
+        only while NO tier transfer has been measured."""
+        tier_rate = (
+            self._restore_rate
+            if self._restore_rate is not None
+            else self._offload_rate
+        )
+        if tier_rate is None or self._prefill_rate is None:
             return True
-        restore_s = n_pages / self._restore_rate
+        restore_s = n_pages / tier_rate
         recompute_s = n_pages * self.page_size / self._prefill_rate
         return restore_s <= recompute_s
 
@@ -376,8 +397,15 @@ class Engine:
             # Bucket the gather width to limit compile count.
             n = 1 << (len(need) - 1).bit_length()
             idx = np.asarray(need + [need[0]] * (n - len(need)), np.int32)
+            t_gather = time.perf_counter()
             k_data = np.asarray(_read_pages_batch(self.k_pages, jnp.asarray(idx)))
             v_data = np.asarray(_read_pages_batch(self.v_pages, jnp.asarray(idx)))
+            # D2H rate sample (np.asarray fences): the cost model's
+            # link-bandwidth bound, available from the first spill.
+            self._offload_rate = self._ema(
+                self._offload_rate,
+                len(need) / max(time.perf_counter() - t_gather, 1e-6),
+            )
             for i, p in enumerate(need):
                 page_data[p] = (k_data[:, i], v_data[:, i])
 

@@ -176,6 +176,11 @@ class PodServerConfig:
             hash_seed=os.environ.get("PYTHONHASHSEED", ""),
             host_pages=int(os.environ.get("HOST_PAGES", 0)),
         )
+        # Host-tier admission: "auto" (self-calibrating recompute-vs-
+        # restore cost model) or "always" (unconditional spill/restore).
+        eng.host_tier_policy = os.environ.get(
+            "HOST_TIER_POLICY", eng.host_tier_policy
+        )
         eng.max_model_len = int(os.environ.get("MAX_MODEL_LEN", eng.max_model_len))
         eng.tp = int(os.environ.get("TP", eng.tp))
         # Sequence-parallel prefill degree (ring attention; long prompts).

@@ -607,7 +607,9 @@ class TestHostDramOffloadTier:
 
         # Tiered: pool so small that prompt A's pages are evicted (to host)
         # by B and C; the repeat of A must restore them and match exactly.
-        eng = _engine(total_pages=12, host_pages=32)
+        # host_tier_policy="always" pins the MECHANISM (restore exactness)
+        # independent of what the cost model thinks of this rig's link.
+        eng = _engine(total_pages=12, host_pages=32, host_tier_policy="always")
         outs = []
         for p in prompts + [prompts[0]]:
             s = eng.add_request(p, SamplingParams(max_new_tokens=5))
@@ -623,7 +625,13 @@ class TestHostDramOffloadTier:
         prompts = [_prompt(40 + i, 16) for i in range(3)]
 
         def run(force_slow_restore):
-            eng = _engine(total_pages=12, host_pages=32)
+            # Baseline arm pins "always" so its restores are guaranteed
+            # regardless of this rig's measured link; the slow arm runs
+            # "auto" with pinned EMAs — the decline under test.
+            eng = _engine(
+                total_pages=12, host_pages=32,
+                host_tier_policy="auto" if force_slow_restore else "always",
+            )
             outs = []
             for p in prompts + [prompts[0]]:
                 if force_slow_restore:
@@ -677,6 +685,8 @@ class TestHostDramOffloadTier:
                 host_pages=host_pages,
                 decode_batch=4,
                 decode_steps_per_iter=4,
+                # mechanism test: spills/restores must actually happen
+                host_tier_policy="always",
             )
             outs = []
             # Concurrent requests on a tight pool: fused-burst reservation
@@ -696,7 +706,8 @@ class TestHostDramOffloadTier:
 
     def test_offload_and_restore_emit_medium_tagged_events(self):
         captured = []
-        eng = _engine(total_pages=12, host_pages=32, on_events=captured.extend)
+        eng = _engine(total_pages=12, host_pages=32, on_events=captured.extend,
+                      host_tier_policy="always")
         a = _prompt(50, 16)
         for p in (a, _prompt(51, 16), _prompt(52, 16), a):
             eng.add_request(p, SamplingParams(max_new_tokens=5))
@@ -716,7 +727,8 @@ class TestHostDramOffloadTier:
         # Host tier smaller than the spill volume: oldest host pages get
         # BlockRemoved(host_dram) and the engine keeps working.
         captured = []
-        eng = _engine(total_pages=12, host_pages=4, on_events=captured.extend)
+        eng = _engine(total_pages=12, host_pages=4, on_events=captured.extend,
+                      host_tier_policy="always")
         for i in range(6):
             eng.add_request(_prompt(60 + i, 16), SamplingParams(max_new_tokens=4))
             eng.run_until_complete()
