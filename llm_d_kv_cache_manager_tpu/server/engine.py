@@ -316,6 +316,24 @@ class Engine:
                 if config.host_tier_policy == "auto"
                 else None,
             )
+            if config.host_tier_policy == "auto":
+                # Probe the device→host link ONCE at init so the cost
+                # model gates the very first spill wave — without this,
+                # everything evicted before the first flush ships
+                # ungated, which is exactly the expensive warm-up on slow
+                # links the model exists to avoid. Probe a 16-page batch:
+                # a single page would mostly measure dispatch latency and
+                # wrongly condemn the tier on fast links.
+                n_probe = min(16, config.block_manager.total_pages)
+                t0 = time.perf_counter()
+                np.asarray(
+                    _read_pages_batch(
+                        self.k_pages, jnp.zeros((n_probe,), jnp.int32)
+                    )
+                )
+                self._offload_rate = n_probe / max(
+                    time.perf_counter() - t0, 1e-6
+                )
         self._pending_offloads: list = []
         self._pending_restores: list = []
         self._off_by_slot: dict = {}
