@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all native test unit-test e2e-test demo bench bench-smoke bench-8b bench-pressure bench-lag10 \
+.PHONY: all native test fast-test unit-test e2e-test demo bench bench-smoke bench-8b bench-pressure bench-tier bench-lag10 \
         routing-bench engine-bench engine-bench-8b moe-bench poolsize-bench \
         kernel-parity dryrun docker lint
 
@@ -17,6 +17,11 @@ native:
 ## Full test suite (CPU, virtual 8-device mesh via tests/conftest.py).
 test:
 	$(PY) -m pytest tests/ -q
+
+## Fast pre-commit loop (<5 min): heavy fuzz matrices / sweeps / numerics
+## oracles are auto-marked `slow` (tests/conftest.py table).
+fast-test:
+	$(PY) -m pytest tests/ -q -m "not slow"
 
 unit-test:
 	$(PY) -m pytest tests/ -q -k "not e2e and not pod_server"
@@ -44,8 +49,14 @@ bench-8b:
 
 ## Pool-pressure regime: precise (blended) vs the capacity-LRU comparator
 ## at a thrash-sized pool — where eviction-awareness and affinity matter.
+## (The default `bench` now also runs this regime as its second pass.)
 bench-pressure:
 	BENCH_TOTAL_PAGES=1536 BENCH_POLICIES=precise,estimated $(PY) bench.py
+
+## Host-DRAM tier A/B at the round-3 thrash config (results/tiering.md).
+bench-tier:
+	BENCH_TOTAL_PAGES=192 BENCH_GROUPS=8 BENCH_PREFIX_LEN=2048 \
+	BENCH_HOST_PAGES=1024 BENCH_POLICIES=precise BENCH_PRESSURE=0 $(PY) bench.py
 
 ## Event-plane lag sweep endpoint (default lag is 2 ms; 0 = optimistic).
 bench-lag10:
