@@ -325,12 +325,15 @@ class Engine:
                 # a single page would mostly measure dispatch latency and
                 # wrongly condemn the tier on fast links.
                 n_probe = min(16, config.block_manager.total_pages)
+                idx = jnp.zeros((n_probe,), jnp.int32)
+                # Warm-up call first: the timed sample must not include
+                # the jit trace+compile of the gather (a compile-polluted
+                # rate would understate fast links ~100x and permanently
+                # decline every spill — no flush would ever run to
+                # replace the bogus sample).
+                np.asarray(_read_pages_batch(self.k_pages, idx))
                 t0 = time.perf_counter()
-                np.asarray(
-                    _read_pages_batch(
-                        self.k_pages, jnp.zeros((n_probe,), jnp.int32)
-                    )
-                )
+                np.asarray(_read_pages_batch(self.k_pages, idx))
                 self._offload_rate = n_probe / max(
                     time.perf_counter() - t0, 1e-6
                 )
@@ -403,8 +406,6 @@ class Engine:
     def _flush_page_moves(self) -> None:
         if not self._pending_offloads and not self._pending_restores:
             return
-        n_restores = len({p for p, _ in self._pending_restores})
-        t0 = time.perf_counter() if n_restores else 0.0
         # One batched gather for every device page any queued move reads.
         need = []
         for _, src in self._pending_offloads + self._pending_restores:
@@ -434,6 +435,11 @@ class Engine:
             self._host_k[slot], self._host_v[slot] = resolve(src)
 
         if self._pending_restores:
+            # Rate window starts HERE: a mixed flush must not charge the
+            # offload snapshots' gather/memcpys to the restores (that
+            # understated restore_rate ~15x under thrash and biased the
+            # cost model toward declining genuinely-cheap restores).
+            t0 = time.perf_counter()
             total = self.config.block_manager.total_pages
             # Dedupe by destination page, LAST queued restore wins: a page
             # restored, rolled back, recycled, and restored again within
@@ -458,7 +464,7 @@ class Engine:
             np.asarray(self.k_pages[0, 0, 0, 0, 0])
             self._restore_rate = self._ema(
                 self._restore_rate,
-                n_restores / max(time.perf_counter() - t0, 1e-6),
+                len(dst) / max(time.perf_counter() - t0, 1e-6),
             )
 
         self._pending_offloads.clear()
