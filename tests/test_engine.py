@@ -1132,7 +1132,7 @@ class TestDecodePathParityFuzz:
              spec_rounds=3),
     ]
 
-    @pytest.mark.parametrize("seed", [101, 202, 303])
+    @pytest.mark.parametrize("seed", [101, 202, 303, 404, 505])
     def test_paths_agree(self, seed):
         rng = np.random.default_rng(seed)
         n_req = int(rng.integers(2, 5))
