@@ -967,11 +967,16 @@ class TestSpeculativeDecode:
         # a pure cycle greedy almost always repeats; keep a soft floor.
         assert eng.spec_stats["proposed"] >= 0
 
-    def test_spec_sampled_lane_generates(self):
-        # temperature>0 runs deterministic-draft speculative sampling; the
-        # request completes with the right count and in-vocab tokens.
+    @pytest.mark.parametrize("rounds", [1, 3])
+    def test_spec_sampled_lane_generates(self, rounds):
+        # temperature>0 runs deterministic-draft speculative sampling
+        # (inside the device scan when rounds > 1); the request completes
+        # with the right count and in-vocab tokens.
         cyc = _prompt(55, 2) * 8
-        eng = _engine(spec_decode="prompt_lookup", spec_k=4, spec_ngram=2)
+        eng = _engine(
+            spec_decode="prompt_lookup", spec_k=4, spec_ngram=2,
+            spec_rounds=rounds,
+        )
         seq = eng.add_request(
             cyc, SamplingParams(max_new_tokens=9, temperature=0.8, top_k=8)
         )
@@ -979,17 +984,22 @@ class TestSpeculativeDecode:
         assert len(seq.generated_tokens) == 9
         assert all(0 <= t < TINY_LLAMA.vocab_size for t in seq.generated_tokens)
 
-    def test_spec_topk1_sampling_equals_greedy(self):
+    @pytest.mark.parametrize("rounds", [1, 3])
+    def test_spec_topk1_sampling_equals_greedy(self, rounds):
         # top_k=1 collapses every filtered distribution to a point mass, so
         # temperature>0 spec sampling must emit EXACTLY the greedy stream —
-        # a deterministic end-to-end check of the acceptance/residual math.
+        # a deterministic end-to-end check of the acceptance/residual math,
+        # including through the multi-round device scan.
         cyc = _prompt(57, 3) * 6
         outs = []
         for sampling in (
             SamplingParams(max_new_tokens=10),
             SamplingParams(max_new_tokens=10, temperature=0.9, top_k=1),
         ):
-            eng = _engine(spec_decode="prompt_lookup", spec_k=4, spec_ngram=2)
+            eng = _engine(
+                spec_decode="prompt_lookup", spec_k=4, spec_ngram=2,
+                spec_rounds=rounds,
+            )
             seq = eng.add_request(list(cyc), sampling)
             eng.run_until_complete()
             outs.append(seq.generated_tokens)
