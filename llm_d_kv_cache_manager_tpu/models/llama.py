@@ -1133,24 +1133,17 @@ def decode_steps(
     jax.jit,
     static_argnames=(
         "cfg", "page_size", "num_rounds", "s_chunk", "ngram", "spec_k",
-        "max_scan", "mesh", "attn_impl",
+        "max_scan", "table_w", "mesh", "attn_impl",
     ),
     donate_argnames=("k_pages", "v_pages"),
 )
 def spec_decode_steps(
     params: Params,
     cfg: LlamaConfig,
-    window: jnp.ndarray,  # [b, W] int32 — last-W committed tokens per lane
-    wlen: jnp.ndarray,  # [b] int32 — valid tokens in window (suffix of seq)
-    seq_lens: jnp.ndarray,  # [b] int32 — committed tokens (0 = inactive lane)
-    budgets: jnp.ndarray,  # [b] int32 — remaining emittable tokens
-    gate_open: jnp.ndarray,  # [b] bool — adaptive gate state (host-managed)
+    packed_i32: jnp.ndarray,  # [b, W + table_w + 5] int32 — see below
+    fparams: jnp.ndarray,  # [b, 2] f32 — (temperature, top_p) per lane
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,
-    block_tables: jnp.ndarray,  # [b, P] int32 — covers the burst's growth
-    temperature: jnp.ndarray,  # [b] f32; 0 = greedy
-    top_k: jnp.ndarray,  # [b] int32
-    top_p: jnp.ndarray,  # [b] f32
     rng_key: jax.Array,
     *,
     page_size: int,
@@ -1159,6 +1152,7 @@ def spec_decode_steps(
     ngram: int,
     spec_k: int,
     max_scan: int,
+    table_w: int,  # block-table width inside packed_i32
     mesh=None,
     attn_impl: str = "xla",
 ) -> tuple[jnp.ndarray, ...]:
@@ -1191,13 +1185,28 @@ def spec_decode_steps(
     its last position (emitting nothing) — wasted-but-safe, like finished
     lanes inside a fused burst.
 
+    Transfer discipline (both directions measured material on
+    high-latency links — ~12 ms/burst for nine small uploads vs one):
+    the int32 inputs arrive as ONE packed array,
+    ``packed_i32 = [window | block_tables | wlen, seq_lens, budget,
+    gate_open, top_k]`` (columns ``[:W]``, ``[W:W+table_w]``, then five
+    per-lane scalars), plus one f32 ``fparams = (temperature, top_p)``.
     Returns ``(packed [rounds, b, spec_k+4] int32, k_pages, v_pages)``
     where ``packed[..., :k+1]`` are the emitted tokens and
     ``packed[..., k+1:k+4]`` are (emit_len, prop_len, accepted) — ONE
-    array so the burst costs a single blocking device→host fetch (four
-    separate fetches measurably serialized on high-latency links).
+    array so the burst costs a single blocking device→host fetch.
     """
-    b, W = window.shape
+    W = packed_i32.shape[1] - table_w - 5
+    window = packed_i32[:, :W]
+    block_tables = packed_i32[:, W : W + table_w]
+    wlen = packed_i32[:, W + table_w]
+    seq_lens = packed_i32[:, W + table_w + 1]
+    budgets = packed_i32[:, W + table_w + 2]
+    gate_open = packed_i32[:, W + table_w + 3].astype(bool)
+    top_k = packed_i32[:, W + table_w + 4]
+    temperature = fparams[:, 0]
+    top_p = fparams[:, 1]
+    b = window.shape[0]
     n = ngram
     k = spec_k
     # Window-base offset: window[j] holds the token at global position
