@@ -107,6 +107,12 @@ def main() -> int:
             for _ in range(n_reqs)
         ]
 
+    # Section selection (BENCH_SECTIONS=prefill,decode,spec): re-run one
+    # measurement without paying the others' warm/compile/measure time.
+    sections = set(
+        os.environ.get("BENCH_SECTIONS", "prefill,decode,spec").split(",")
+    )
+
     # Warmup: compile prefill + decode shapes.
     eng = Engine(cfg, params=params)
     for r in reqs()[:2]:
@@ -116,28 +122,29 @@ def main() -> int:
 
     # Prefill throughput: cold engine, time prompt processing only
     # (max_new_tokens=1 → ~pure prefill).
-    eng = Engine(cfg, params=params)
-    batch = reqs()
-    t0 = time.perf_counter()
-    for r in batch:
-        eng.add_request(r, SamplingParams(max_new_tokens=1))
-    eng.run_until_complete()
-    dt = time.perf_counter() - t0
-    prefill_tps = n_reqs * prefill_len / dt
-    print(
-        json.dumps(
-            {
-                "metric": "prefill_throughput",
-                "value": round(prefill_tps, 1),
-                "unit": "tok/s",
-                "model": mode,
-                "prefill_len": prefill_len,
-                "n_requests": n_reqs,
-                "backend": jax.default_backend(),
-            }
+    if "prefill" in sections:
+        eng = Engine(cfg, params=params)
+        batch = reqs()
+        t0 = time.perf_counter()
+        for r in batch:
+            eng.add_request(r, SamplingParams(max_new_tokens=1))
+        eng.run_until_complete()
+        dt = time.perf_counter() - t0
+        prefill_tps = n_reqs * prefill_len / dt
+        print(
+            json.dumps(
+                {
+                    "metric": "prefill_throughput",
+                    "value": round(prefill_tps, 1),
+                    "unit": "tok/s",
+                    "model": mode,
+                    "prefill_len": prefill_len,
+                    "n_requests": n_reqs,
+                    "backend": jax.default_backend(),
+                }
+            )
         )
-    )
-    del eng
+        del eng
 
     # Decode throughput: saturate the decode lanes, measure generated tok/s
     # once prefill is done (prompts short so decode dominates). A throwaway
@@ -162,44 +169,48 @@ def main() -> int:
         dt = time.perf_counter() - t0
         return (sum(s.num_generated for s in seqs) - gen0) / dt
 
-    decode_round()  # identical throwaway round: compiles every decode shape
-    decode_tps = decode_round()
-    print(
-        json.dumps(
-            {
-                "metric": "decode_throughput",
-                "value": round(decode_tps, 1),
-                "unit": "tok/s",
-                "model": mode,
-                "decode_batch": decode_batch,
-                "decode_steps_per_iter": burst,
-                "backend": jax.default_backend(),
-            }
+    from dataclasses import replace
+
+    if "decode" in sections:
+        decode_round()  # identical throwaway: compiles every decode shape
+        decode_tps = decode_round()
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_throughput",
+                    "value": round(decode_tps, 1),
+                    "unit": "tok/s",
+                    "model": mode,
+                    "decode_batch": decode_batch,
+                    "decode_steps_per_iter": burst,
+                    "backend": jax.default_backend(),
+                }
+            )
         )
-    )
 
     # Pipelined decode: burst N+1 dispatched before burst N commits, hiding
     # per-iteration host work (the ~120ms tunnel dispatch tax in dev; ~ms on
     # TPU-VM) under device execution. Same shapes → no extra compiles.
-    from dataclasses import replace
-
-    cfg_pipe = replace(cfg, decode_pipeline=True)
-    decode_round(cfg_pipe)  # throwaway (warm page-pool state path)
-    decode_pipe_tps = decode_round(cfg_pipe)
-    print(
-        json.dumps(
-            {
-                "metric": "decode_throughput_pipelined",
-                "value": round(decode_pipe_tps, 1),
-                "unit": "tok/s",
-                "model": mode,
-                "decode_batch": decode_batch,
-                "decode_steps_per_iter": burst,
-                "vs_unpipelined": round(decode_pipe_tps / max(decode_tps, 1e-9), 3),
-                "backend": jax.default_backend(),
-            }
+    if "decode" in sections:
+        cfg_pipe = replace(cfg, decode_pipeline=True)
+        decode_round(cfg_pipe)  # throwaway (warm page-pool state path)
+        decode_pipe_tps = decode_round(cfg_pipe)
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_throughput_pipelined",
+                    "value": round(decode_pipe_tps, 1),
+                    "unit": "tok/s",
+                    "model": mode,
+                    "decode_batch": decode_batch,
+                    "decode_steps_per_iter": burst,
+                    "vs_unpipelined": round(
+                        decode_pipe_tps / max(decode_tps, 1e-9), 3
+                    ),
+                    "backend": jax.default_backend(),
+                }
+            )
         )
-    )
 
     # Speculative decoding (prompt-lookup): only pays off when greedy
     # output echoes the context, so measure on a repetition-heavy workload
@@ -207,9 +218,12 @@ def main() -> int:
     # against plain decode on the SAME workload, small batch (the regime
     # where per-dispatch overhead dominates and spec's multi-token commits
     # matter most). BENCH_SPEC=0 skips.
-    if os.environ.get("BENCH_SPEC", "1") != "0":
+    if "spec" in sections and os.environ.get("BENCH_SPEC", "1") != "0":
         spec_batch = int(os.environ.get("BENCH_SPEC_BATCH", 4))
-        pattern = rng.integers(0, model_cfg.vocab_size, 12).tolist()
+        # Dedicated rng: the spec workload must be identical whether or
+        # not the earlier sections (which consume `rng`) ran.
+        spec_rng = np.random.default_rng(1729)
+        pattern = spec_rng.integers(0, model_cfg.vocab_size, 12).tolist()
 
         def spec_round(c) -> tuple[float, dict]:
             eng = Engine(replace(c, decode_batch_size=spec_batch), params=params)
