@@ -31,7 +31,7 @@ from ..utils import get_logger
 from .block_manager import AllocationError, BlockManager, BlockManagerConfig
 from ..ops.sampling import sample_tokens
 from .scheduler import Scheduler, SchedulerConfig
-from .sequence import SamplingParams, Sequence, SequenceStatus
+from .sequence import SamplingParams, Sequence
 
 log = get_logger("server.engine")
 
