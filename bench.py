@@ -56,6 +56,10 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
                        640 @8b-int8 — past the working set, so pods evict
                        and the index's eviction awareness shows; the
                        reference's own headline regime)
+  BENCH_STALL_CAP_X=N  virtual-clock stall rejection: cap a step's wall
+                       contribution at N x the pod's trailing median
+                       (default 20; 0 disables). Clamped time is reported
+                       per policy in the detail JSON.
 """
 
 from __future__ import annotations
@@ -138,10 +142,25 @@ class LaggedEventBus:
         self.release(float("inf"))
 
 
+#: Stall rejection for the virtual clock (BENCH_STALL_CAP_X; 0 disables):
+#: a step's wall-time contribution is capped at this multiple of the
+#: pod's trailing-median step time (floor 1 s). The co-sim attributes
+#: MEASURED step wall time to a pod's virtual clock, so a multi-minute
+#: dev-tunnel wedge during one step would charge a real deployment's
+#: pod with a stall no TPU-VM ever sees and poison the whole policy's
+#: tail (observed: one 7-minute stall turned a 3 s pressure p90 into
+#: 206 s). Clamped time is counted and reported in the detail JSON —
+#: a run that needed heavy clamping is visibly flagged, not silently
+#: cleaned.
+STALL_CAP_X = float(os.environ.get("BENCH_STALL_CAP_X", "20"))
+
+
 class Pod:
     """One simulated serving replica: a real engine + a virtual clock."""
 
     def __init__(self, pod_id, engine_cfg, params, publish, bus):
+        from collections import deque
+
         from llm_d_kv_cache_manager_tpu.server.engine import Engine
 
         self.pod_id = pod_id
@@ -159,6 +178,9 @@ class Pod:
         self.seqs = []  # every sequence routed here
         self.hit_stats: dict[int, tuple[int, int]] = {}  # first-prefill hits
         self._first_token_seen: set[int] = set()
+        self._step_samples = deque(maxlen=64)
+        self.stall_clamped_s = 0.0
+        self.stall_clamped_steps = 0
 
     @property
     def load(self) -> int:
@@ -168,7 +190,16 @@ class Pod:
     def step_timed(self, ttfts, arrivals):
         t0 = time.perf_counter()
         done = self.engine.step()
-        self.clock += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        if STALL_CAP_X and len(self._step_samples) >= 20:
+            med = sorted(self._step_samples)[len(self._step_samples) // 2]
+            cap = max(med * STALL_CAP_X, 1.0)
+            if dt > cap:
+                self.stall_clamped_s += dt - cap
+                self.stall_clamped_steps += 1
+                dt = cap
+        self._step_samples.append(dt)
+        self.clock += dt
         if self._unstamped:
             for msg in self._unstamped:
                 self.bus.stage(msg, self.clock)
@@ -352,6 +383,8 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     prompt_tokens = sum(n for p in pods for _, n in p.hit_stats.values())
     cached_tokens = sum(c for p in pods for c, _ in p.hit_stats.values())
     out_tokens = sum(len(s.output_tokens) for p in pods for s in p.seqs)
+    stall_clamped_s = sum(p.stall_clamped_s for p in pods)
+    stall_clamped_steps = sum(p.stall_clamped_steps for p in pods)
     # The Pod.on_events closure references the Pod (staging buffer), so
     # Pod <-> Engine is now a reference CYCLE: without an explicit collect,
     # each policy's engines (~GBs of donated KV pools on the chip) survive
@@ -372,6 +405,10 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
             float(cached_tokens / prompt_tokens) if prompt_tokens else 0.0
         ),
         "makespan_s": float(makespan),
+        # Tunnel-stall rejection accounting (see STALL_CAP_X): nonzero
+        # means wall-time wedges were clamped out of the virtual clocks.
+        "stall_clamped_s": round(stall_clamped_s, 3),
+        "stall_clamped_steps": stall_clamped_steps,
     }
 
 
