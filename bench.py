@@ -60,6 +60,10 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
                        contribution at N x the pod's trailing median
                        (default 20; 0 disables). Clamped time is reported
                        per policy in the detail JSON.
+  BENCH_CHUNKED_PREFILL_TOKENS=N  per-step prefill chunk budget (chunked
+                       prefill + mixed prefill/decode steps; 0/unset =
+                       legacy either-or scheduling) — the TTFT/ITL
+                       trade-off knob
 """
 
 from __future__ import annotations
@@ -516,12 +520,17 @@ def main() -> int:
     assert all(p in ALL_POLICIES for p in policies), policies
 
     max_len = prefix_len + suffix_len + max_new + page
+    chunked = int(os.environ.get("BENCH_CHUNKED_PREFILL_TOKENS", 0))
     engine_cfg = EngineConfig(
         model=model_cfg,
         block_manager=BlockManagerConfig(
             total_pages=total_pages, page_size=page, host_pages=host_pages
         ),
-        scheduler=SchedulerConfig(max_prefill_batch=4, max_prefill_tokens=8192),
+        scheduler=SchedulerConfig(
+            max_prefill_batch=4,
+            max_prefill_tokens=8192,
+            chunked_prefill_tokens=chunked if chunked > 0 else None,
+        ),
         max_model_len=max_len,
         decode_batch_size=8,
         decode_steps_per_iter=decode_burst,
@@ -635,6 +644,7 @@ def main() -> int:
         "prefix_len": prefix_len,
         "host_pages": host_pages,
         "total_pages": total_pages,
+        "chunked_prefill_tokens": chunked if chunked > 0 else None,
         "event_lag_ms": float(os.environ.get("BENCH_EVENT_LAG_MS", "2")),
         "qps_ramp": [round(q, 2) for q in qps_ramp],
         "results": results,

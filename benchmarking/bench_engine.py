@@ -85,10 +85,19 @@ def main() -> int:
     if quantize and not mode.endswith("int8"):
         mode = f"{mode}+int8"  # label tracks the weights actually served
     max_len = prefill_len + max_new + page
+    # Chunked prefill + mixed steps (BENCH_CHUNKED_PREFILL_TOKENS=N;
+    # 0/unset = legacy). Prefill throughput then pays one dispatch per
+    # chunk — the cost side of the ITL win bench_chunked_interference.py
+    # measures.
+    chunked = int(os.environ.get("BENCH_CHUNKED_PREFILL_TOKENS", 0))
     cfg = EngineConfig(
         model=model_cfg,
         block_manager=BlockManagerConfig(total_pages=total_pages, page_size=page),
-        scheduler=SchedulerConfig(max_prefill_batch=4, max_prefill_tokens=8192),
+        scheduler=SchedulerConfig(
+            max_prefill_batch=4,
+            max_prefill_tokens=8192,
+            chunked_prefill_tokens=chunked if chunked > 0 else None,
+        ),
         max_model_len=max_len,
         decode_batch_size=decode_batch,
         decode_steps_per_iter=burst,

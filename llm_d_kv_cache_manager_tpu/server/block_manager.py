@@ -318,6 +318,7 @@ class BlockManager:
         seq.block_table = block_table
         seq.num_cached_prompt = cached_tokens
         seq.num_computed = cached_tokens
+        seq.num_prefilled = cached_tokens
         # Cache-hit pages are already registered; continue the hash chain
         # from the last reused page.
         n_reused = cached_tokens // ps

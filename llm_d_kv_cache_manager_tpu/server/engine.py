@@ -4,9 +4,12 @@ This is the in-tree TPU serving engine the BASELINE north star calls for:
 the component the reference *drives externally* (vLLM pods) is a
 first-class part of this framework. Per step the engine either prefills a
 batch of admitted prompts (suffix-only on prefix-cache hits) or decodes one
-token for every running sequence via the Pallas paged-attention kernel,
-then publishes ``BlockStored``/``BlockRemoved`` events so the routing
-indexer tracks this replica's cache (SURVEY §3.2 write path).
+token for every running sequence via the Pallas paged-attention kernel —
+or, with ``chunked_prefill_tokens`` set, runs a MIXED step that packs a
+token-budgeted batch of prefill chunks *and* all decode lanes into one
+iteration (Sarathi-style stall-free ingest) — then publishes
+``BlockStored``/``BlockRemoved`` events so the routing indexer tracks this
+replica's cache (SURVEY §3.2 write path).
 
 XLA discipline: all jitted entry points see bucketed static shapes
 (prefill length rounded up to a bucket, decode batch padded to a fixed
@@ -201,10 +204,19 @@ class Engine:
 
         self.block_manager = BlockManager(config.block_manager, on_events=on_events)
         import dataclasses
+        import math
 
+        cpt = config.scheduler.chunked_prefill_tokens
+        if cpt is not None and cpt < 1:
+            raise ValueError(
+                "chunked_prefill_tokens must be >= 1 (None disables chunking)"
+            )
         sched_cfg = dataclasses.replace(
             config.scheduler,
             max_running=min(config.scheduler.max_running, config.decode_batch_size),
+            # Non-final chunks must end page-aligned (the next chunk's paged
+            # context is whole pages) and land on the prefill shape buckets.
+            chunk_align=math.lcm(config.prefill_bucket, ps),
         )
         self.scheduler = Scheduler(self.block_manager, sched_cfg)
 
@@ -330,10 +342,14 @@ class Engine:
                 # the jit trace+compile of the gather (a compile-polluted
                 # rate would understate fast links ~100x and permanently
                 # decline every spill — no flush would ever run to
-                # replace the bogus sample).
+                # replace the bogus sample). Probe BOTH k and v pools: a
+                # "page" everywhere else in the cost model means a k+v
+                # pair (flush gathers both), so a k-only probe would
+                # overstate the link 2x.
                 np.asarray(_read_pages_batch(self.k_pages, idx))
                 t0 = time.perf_counter()
                 np.asarray(_read_pages_batch(self.k_pages, idx))
+                np.asarray(_read_pages_batch(self.v_pages, idx))
                 self._offload_rate = n_probe / max(
                     time.perf_counter() - t0, 1e-6
                 )
@@ -420,10 +436,15 @@ class Engine:
             k_data = np.asarray(_read_pages_batch(self.k_pages, jnp.asarray(idx)))
             v_data = np.asarray(_read_pages_batch(self.v_pages, jnp.asarray(idx)))
             # D2H rate sample (np.asarray fences): the cost model's
-            # link-bandwidth bound, available from the first spill.
+            # link-bandwidth bound, available from the first spill. Divide
+            # by the PADDED gather width — those pages were actually
+            # transferred — so this sample measures the same pages/s the
+            # init probe and the restore sample do (an unpadded divisor
+            # understated the rate up to 2x near power-of-2 boundaries and
+            # could flip recompute-vs-restore on near-break-even links).
             self._offload_rate = self._ema(
                 self._offload_rate,
-                len(need) / max(time.perf_counter() - t_gather, 1e-6),
+                n / max(time.perf_counter() - t_gather, 1e-6),
             )
             for i, p in enumerate(need):
                 page_data[p] = (k_data[:, i], v_data[:, i])
@@ -461,10 +482,11 @@ class Engine:
             )
             # Fence with a scalar fetch (block_until_ready is lazy on the
             # tunnel) so the restore-rate sample covers the real DMA.
+            # Padded-width divisor, same rationale as the offload sample.
             np.asarray(self.k_pages[0, 0, 0, 0, 0])
             self._restore_rate = self._ema(
                 self._restore_rate,
-                len(dst) / max(time.perf_counter() - t0, 1e-6),
+                n / max(time.perf_counter() - t0, 1e-6),
             )
 
         self._pending_offloads.clear()
@@ -504,16 +526,27 @@ class Engine:
         return self.scheduler.has_work
 
     def step(self) -> list[Sequence]:
-        """One engine iteration. Returns sequences finished this step."""
+        """One engine iteration. Returns sequences finished this step.
+
+        Legacy scheduling runs either a prefill batch or a decode step.
+        With ``chunked_prefill_tokens`` set the scheduler returns a MIXED
+        step — a budgeted chunk batch *and* every running decode lane —
+        and both dispatch in the same iteration, so a long prompt's ingest
+        never stalls running decodes for more than one chunk's compute."""
         out = self.scheduler.schedule()
         if out.prefill:
             # Prefill must see committed decode state (page accounting,
             # finish detection) — never overlaps an in-flight burst.
             self._drain_inflight()
-            self._run_prefill(out.prefill)
-        elif out.decode:
+            self._run_prefill(out.prefill, out.chunks)
+        if out.decode:
+            # Mixed step: decode lanes snapshotted at schedule time — a
+            # final-chunk sequence published above joins NEXT step (same
+            # cadence as a legacy prefill step), and lanes the chunk batch
+            # preempted are dropped by the decode paths' block_table/finish
+            # filters.
             self._run_decode(out.decode)
-        else:
+        elif not out.prefill:
             self._drain_inflight()
 
         newly_finished = []
@@ -546,12 +579,23 @@ class Engine:
             return True
         return seq.num_tokens >= self.config.max_model_len
 
-    def _run_prefill(self, seqs: list[Sequence]) -> None:
+    def _run_prefill(
+        self, seqs: list[Sequence], chunks: Optional[list[int]] = None
+    ) -> None:
+        """Prefill one batch. ``chunks[i]`` = prompt tokens to process for
+        ``seqs[i]`` this step (chunked mixed-step scheduling); ``None`` =
+        each sequence's whole fresh suffix (legacy whole-prompt prefill).
+        Either way every row is the same warm-prefill dispatch shape: a
+        fresh slice attending over the paged context already resident —
+        prefix-cache hits for chunk 0, plus the pages written by chunks
+        0..N-1 for later chunks. Only a sequence's FINAL chunk samples a
+        first token and publishes it to the decode lanes."""
         ps = self.page_size
+        if chunks is None:
+            chunks = [s.prompt_remaining for s in seqs]
         # Static shapes for jit-cache stability: batch padded to the
         # configured prefill width, chunk length and context pages bucketed.
-        suffix_lens = [len(s.prompt_tokens) - s.num_cached_prompt for s in seqs]
-        chunk = _round_up(max(suffix_lens), self.config.prefill_bucket)
+        chunk = _round_up(max(chunks), self.config.prefill_bucket)
         b = self.config.scheduler.max_prefill_batch
 
         tokens = np.zeros((b, chunk), np.int32)
@@ -561,15 +605,14 @@ class Engine:
         slot_ids = np.zeros((b, chunk), np.int32)
         # Zero-width context when the whole batch is cache-cold: skips the
         # per-layer context gather/score entirely (its own jit trace).
-        max_ctx = max(s.num_cached_prompt // ps for s in seqs)
+        max_ctx = max(s.num_prefilled // ps for s in seqs)
         ctx_pages = _round_up(max_ctx, self.config.prefill_ctx_bucket)
         ctx_bt = np.zeros((b, ctx_pages), np.int32)
         ctx_lens = np.zeros((b,), np.int32)
 
-        for i, seq in enumerate(seqs):
-            start = seq.num_cached_prompt
-            n = len(seq.prompt_tokens) - start
-            tokens[i, :n] = seq.prompt_tokens[start:]
+        for i, (seq, n) in enumerate(zip(seqs, chunks)):
+            start = seq.num_prefilled
+            tokens[i, :n] = seq.prompt_tokens[start : start + n]
             pos = np.arange(start, start + n)
             positions[i, :n] = pos
             valid[i, :n] = True
@@ -607,18 +650,27 @@ class Engine:
             float(valid.sum()) / max(time.perf_counter() - t0, 1e-6),
         )
         now = time.monotonic()
+        finals = [
+            seq
+            for seq, n in zip(seqs, chunks)
+            if seq.num_prefilled + n >= len(seq.prompt_tokens)
+        ]
         # Admit to running BEFORE appending slots: batchmates must be
         # preemption candidates if page growth exhausts the pool here.
-        self.scheduler.on_prefill_done(seqs)
-        for seq, tok in zip(seqs, first_tokens):
+        self.scheduler.on_prefill_done(finals)
+        for (seq, n), tok in zip(zip(seqs, chunks), first_tokens):
             if not seq.block_table:
                 continue  # preempted by an earlier seq in this very batch
-            seq.num_computed = len(seq.prompt_tokens)
-            seq.output_tokens.append(int(tok))
-            seq.num_generated += 1
-            if seq.first_token_time is None:
-                seq.first_token_time = now
-            self._append_slot_or_preempt(seq)
+            seq.num_prefilled += n
+            seq.num_computed = seq.num_prefilled
+            if seq.prompt_remaining == 0:
+                # Final chunk: the last-position logits are the first-token
+                # logits of the whole prompt — sample and publish.
+                seq.output_tokens.append(int(tok))
+                seq.num_generated += 1
+                if seq.first_token_time is None:
+                    seq.first_token_time = now
+                self._append_slot_or_preempt(seq)
             self.block_manager.register_full_pages(seq)
 
     def _decode_table_width(self, seqs: list[Sequence]) -> int:
@@ -634,8 +686,14 @@ class Engine:
         if self.config.spec_decode == "prompt_lookup":
             # Commit lag: the drain can finish lanes — never reserve for or
             # dispatch a finished sequence (same rule as the fused path).
+            # Lanes a mixed step's prefill half preempted (empty block
+            # table) are dropped too: their proposals must not defeat the
+            # all-empty fast path back to plain decode.
             self._drain_inflight()
-            seqs = [s for s in seqs if not self._should_finish(s)]
+            seqs = [
+                s for s in seqs
+                if s.block_table and not self._should_finish(s)
+            ]
             if not seqs:
                 return
             if self._run_decode_spec(seqs):
@@ -924,6 +982,30 @@ class Engine:
         if not any(prop_by_id.values()):
             return False
 
+        if rounds > 1:
+            # Multi-round bursts reserve the budget-capped worst case
+            # (later rounds' proposals are decided on device), which under
+            # pool pressure can preempt batchmates for capacity that is
+            # mostly unused at low acceptance. When the worst case doesn't
+            # fit the free pool, degrade THIS burst to a single round: its
+            # reservation is exact (the host proposal), so speculation
+            # never evicts a batchmate for headroom it may not use. Shapes
+            # stay static per dispatch — the degraded burst uses the
+            # spec_rounds=1 executable family (one extra compile the first
+            # time pressure hits).
+            need = 0
+            for seq in seqs:
+                if not seq.block_table:
+                    continue
+                worst = 1 + min(rounds * (k + 1), self._spec_budget(seq))
+                need += max(
+                    0,
+                    -(-(seq.num_tokens + worst - 1) // ps)
+                    - len(seq.block_table),
+                )
+            if need > self.block_manager.num_free:
+                rounds = 1
+
         # Reserve before building tables (can preempt batchmates — or
         # abort; both leave block_table empty). Single-round bursts
         # reserve the sequence's exact growth (1 committed + its clamped
@@ -1015,6 +1097,12 @@ class Engine:
             if not seq.block_table:
                 continue  # preempted by a batchmate's reservation
             for r in range(rounds):
+                if self._should_finish(seq):
+                    break  # later rounds are surplus (discarded)
+                # Stats/gate updates only for rounds whose emissions are
+                # (at least partly) committed: a discarded surplus round
+                # would inflate the reported acceptance rate and mutate
+                # gate state for a finished sequence.
                 pl = int(prop_len[r, i])
                 ac = int(acc[r, i])
                 self.spec_stats["proposed"] += pl
@@ -1027,8 +1115,6 @@ class Engine:
                     seq.num_computed = seq.num_tokens
                     seq.output_tokens.append(int(emit[r, i, j]))
                     seq.num_generated += 1
-                if self._should_finish(seq):
-                    break  # later rounds are surplus (discarded)
             # The burst reservation covered exactly the burst's writes; a
             # full acceptance in the last committed round advances
             # num_tokens past them, so the NEXT dispatch's input token
@@ -1076,9 +1162,14 @@ class Engine:
         """Modeled cost of preempting ``cand`` and bringing it back later:
         registered pages survive in the prefix cache or spill to the
         host tier (per-page cost = the cheaper of restore DMA and
-        recompute), unregistered tokens are pure recompute."""
+        recompute), unregistered COMPUTED tokens are pure recompute.
+        Counted off ``num_computed``, not ``num_tokens``: a mid-prefill
+        sequence's unprefilled prompt tail costs the same whether or not
+        it is preempted, so it must not inflate the marginal cost (it
+        would steer the policy away from exactly the barely-started
+        prefills that are the cheapest victims)."""
         reg_pages = cand.num_registered_pages
-        fresh_toks = max(cand.num_tokens - reg_pages * self.page_size, 0)
+        fresh_toks = max(cand.num_computed - reg_pages * self.page_size, 0)
         per_page_recompute = self.page_size / self._prefill_rate
         per_page = (
             min(1.0 / self._restore_rate, per_page_recompute)
@@ -1094,10 +1185,15 @@ class Engine:
         (recompute-vs-restore aware) wins, recency breaking ties.
         Never picks sequences that are done generating (they finish right
         after the caller's loop) — re-prefilling one would emit an extra
-        token beyond its max_new_tokens contract."""
+        token beyond its max_new_tokens contract. Mid-prefill sequences
+        (chunked mode holds their pages across steps) are candidates after
+        every running lane: their registered chunk pages survive in the
+        prefix cache, so the re-prefill is cheap, but knocking out a decode
+        lane loses less progress."""
         candidates = [
             cand
-            for cand in reversed(self.scheduler.running)
+            for cand in list(reversed(self.scheduler.running))
+            + list(reversed(self.scheduler.prefilling))
             if cand is not seq and not self._should_finish(cand)
         ]
         if not candidates:
@@ -1136,7 +1232,7 @@ class Engine:
                     victim=victim.seq_id,
                     for_seq=seq.seq_id,
                 )
-                self.scheduler.running.remove(victim)
+                self.scheduler.on_preempted(victim)
                 self.block_manager.free_sequence(victim)
                 victim.fold_for_preemption()
                 self.scheduler.waiting.appendleft(victim)

@@ -1,11 +1,29 @@
 """Request scheduler: continuous batching with FCFS admission.
 
-Each engine step is either a **prefill step** (admit waiting sequences whose
-pages fit, batched with padding) or a **decode step** (all running
-sequences, one token each). Prefill-priority keeps TTFT low, matching how
-the reference's benchmarked engines schedule (prefill preemption);
-page-budget admission prevents over-commit, and the page pool's LRU
-recycling provides the back-pressure.
+Two scheduling modes share the admission rules (page-budget FCFS, running
+cap):
+
+- **Legacy (default, ``chunked_prefill_tokens=None``)**: each engine step is
+  either a **prefill step** (admit waiting sequences whose pages fit,
+  batched with padding) or a **decode step** (all running sequences, one
+  token each). Prefill-priority keeps TTFT low, matching how the
+  reference's benchmarked engines schedule (prefill preemption).
+
+- **Chunked prefill (``chunked_prefill_tokens`` set)**: every step is a
+  **mixed step** — it packs up to the token budget of prefill-chunk work
+  (resuming partially-prefilled sequences first, then admitting new ones
+  under the same page-budget/FCFS rules) *and* carries all running decode
+  lanes. One long prompt then never stalls running decodes for its whole
+  prefill (Sarathi-Serve-style stall-free scheduling): its ingest is split
+  into budget-sized chunks and decode lanes advance between chunks.
+  Non-final chunks are floored to ``chunk_align`` (the engine sets
+  lcm(prefill_bucket, page_size)) so chunk boundaries stay page-aligned —
+  the next chunk's paged context is then exactly the pages written by
+  chunks 0..N-1 plus any prefix-cache hit, the same warm-prefill shape the
+  engine already compiles.
+
+In both modes the page pool's LRU recycling provides the back-pressure and
+page-budget admission prevents over-commit.
 """
 
 from __future__ import annotations
@@ -27,12 +45,25 @@ class SchedulerConfig:
     max_prefill_batch: int = 8
     #: cap on tokens in one prefill batch (bounds score-matrix memory)
     max_prefill_tokens: int = 8192
+    #: per-step prefill token budget for chunked prefill + mixed
+    #: prefill/decode steps. None (default) keeps the legacy either-or
+    #: scheduling bit-identical; set (e.g. 256-2048) to bound how long any
+    #: single step's prefill work can stall running decode lanes.
+    chunked_prefill_tokens: Optional[int] = None
+    #: alignment for non-final chunk lengths; the engine overrides this
+    #: with lcm(prefill_bucket, page_size) so mid-prefill chunk boundaries
+    #: stay page-aligned (paged-context contract) and dispatch widths stay
+    #: on the jit shape buckets.
+    chunk_align: int = 1
 
 
 @dataclass
 class ScheduleOutput:
     prefill: list[Sequence]
     decode: list[Sequence]
+    #: tokens to prefill per ``prefill`` entry this step (chunked mode;
+    #: None in legacy mode = each entry prefills its whole fresh suffix)
+    chunks: Optional[list[int]] = None
 
 
 class Scheduler:
@@ -41,6 +72,9 @@ class Scheduler:
         self.block_manager = block_manager
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
+        #: admitted (pages allocated) but only partially prefilled — only
+        #: populated in chunked mode; FCFS order preserved.
+        self.prefilling: list[Sequence] = []
 
     def add(self, seq: Sequence) -> None:
         seq.status = SequenceStatus.WAITING
@@ -48,10 +82,12 @@ class Scheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.prefilling or self.running)
 
     def schedule(self) -> ScheduleOutput:
         """Pick the work for one engine step."""
+        if self.config.chunked_prefill_tokens is not None:
+            return self._schedule_chunked()
         # Admit waiting sequences first (prefill priority).
         prefill: list[Sequence] = []
         budget = self.config.max_prefill_tokens
@@ -83,10 +119,89 @@ class Scheduler:
             return ScheduleOutput(prefill=prefill, decode=[])
         return ScheduleOutput(prefill=[], decode=list(self.running))
 
+    def _take_chunk(self, remaining: int, budget: int, align: int) -> int:
+        """Chunk size for a sequence with ``remaining`` fresh prompt tokens
+        under ``budget``: the whole remainder when it fits (final chunk),
+        else the largest align-multiple that fits (0 = budget exhausted for
+        a non-final chunk — the caller stops packing)."""
+        if remaining <= budget:
+            return remaining
+        return (budget // align) * align
+
+    def _schedule_chunked(self) -> ScheduleOutput:
+        """Token-budget mixed step: prefill chunks up to the budget plus
+        every running decode lane."""
+        align = max(1, self.config.chunk_align)
+        # A budget below one alignment unit could never form a non-final
+        # chunk; the align clamp is applied LAST (also overriding
+        # max_prefill_tokens) so long prompts always make forward progress
+        # — one align-sized chunk is a single prefill-bucket dispatch, the
+        # minimum width the engine compiles anyway.
+        budget = max(
+            min(self.config.chunked_prefill_tokens, self.config.max_prefill_tokens),
+            align,
+        )
+        prefill: list[Sequence] = []
+        chunks: list[int] = []
+
+        # Resume partially-prefilled sequences first (their pages are
+        # already held — finishing them releases decode capacity soonest).
+        for seq in self.prefilling:
+            if budget <= 0 or len(prefill) >= self.config.max_prefill_batch:
+                break
+            take = self._take_chunk(seq.prompt_remaining, budget, align)
+            if take == 0:
+                break
+            prefill.append(seq)
+            chunks.append(take)
+            budget -= take
+
+        # Then admit new sequences under the page-budget/FCFS rules.
+        while (
+            self.waiting
+            and budget > 0
+            and len(prefill) < self.config.max_prefill_batch
+            and len(self.running) + len(self.prefilling) < self.config.max_running
+        ):
+            seq = self.waiting[0]
+            if not self.block_manager.can_allocate(seq):
+                break  # FCFS: wait for pages rather than starving this seq
+            try:
+                self.block_manager.allocate(seq)
+            except AllocationError:
+                break
+            take = self._take_chunk(seq.prompt_remaining, budget, align)
+            if take == 0:
+                # Not even one aligned chunk fits the leftover budget: roll
+                # back rather than hold pages for a sequence doing nothing
+                # this step.
+                self.block_manager.free_sequence(seq)
+                seq.reset_allocation()
+                break
+            self.waiting.popleft()
+            self.prefilling.append(seq)
+            prefill.append(seq)
+            chunks.append(take)
+            budget -= take
+
+        return ScheduleOutput(
+            prefill=prefill, decode=list(self.running), chunks=chunks
+        )
+
     def on_prefill_done(self, seqs: list[Sequence]) -> None:
         for seq in seqs:
+            if seq in self.prefilling:
+                self.prefilling.remove(seq)
             seq.status = SequenceStatus.RUNNING
             self.running.append(seq)
+
+    def on_preempted(self, seq: Sequence) -> None:
+        """Remove a preempted sequence from whichever active list holds it
+        (running lane, or mid-prefill in chunked mode)."""
+        if seq in self.running:
+            self.running.remove(seq)
+        elif seq in self.prefilling:
+            self.prefilling.remove(seq)
 
     def on_finished(self, seq: Sequence) -> None:
         seq.status = SequenceStatus.FINISHED

@@ -41,6 +41,11 @@ class Sequence:
     num_computed: int = 0
     #: tokens of the prompt served from the prefix cache
     num_cached_prompt: int = 0
+    #: prompt tokens whose K/V are resident (cached prefix + prefilled
+    #: chunks). Equals num_cached_prompt right after allocation and
+    #: len(prompt_tokens) once prefill completes; strictly between the two
+    #: while a sequence is mid-prefill under chunked-prefill scheduling.
+    num_prefilled: int = 0
     #: total generated tokens — survives preemption (output_tokens may be
     #: folded into prompt_tokens when a sequence is preempted and recomputed)
     num_generated: int = 0
@@ -76,11 +81,17 @@ class Sequence:
         """User-visible output, stable across preemption."""
         return self.all_tokens[self.user_prompt_len :]
 
+    @property
+    def prompt_remaining(self) -> int:
+        """Prompt tokens still to prefill (chunked-prefill progress)."""
+        return len(self.prompt_tokens) - self.num_prefilled
+
     def reset_allocation(self) -> None:
         """Clear all page/prefix-cache bookkeeping (single source of truth
         for rollback and preemption)."""
         self.num_computed = 0
         self.num_cached_prompt = 0
+        self.num_prefilled = 0
         self.num_registered_pages = 0
         self.last_chain_hash = None
 

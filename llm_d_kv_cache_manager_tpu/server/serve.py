@@ -182,6 +182,11 @@ class PodServerConfig:
             "HOST_TIER_POLICY", eng.host_tier_policy
         )
         eng.max_model_len = int(os.environ.get("MAX_MODEL_LEN", eng.max_model_len))
+        # Chunked prefill + mixed steps: per-step prefill token budget so a
+        # long prompt's ingest never stalls running decode lanes (0/unset =
+        # legacy either-or scheduling).
+        cpt = int(os.environ.get("CHUNKED_PREFILL_TOKENS", 0))
+        eng.scheduler.chunked_prefill_tokens = cpt if cpt > 0 else None
         eng.tp = int(os.environ.get("TP", eng.tp))
         # Sequence-parallel prefill degree (ring attention; long prompts).
         eng.sp = int(os.environ.get("SP", eng.sp))

@@ -73,6 +73,7 @@ def pytest_configure(config):
 #: paths the fast tests pin directly, or parity oracles that only move when
 #: the model/ops layer changes.
 _SLOW_CLASSES = {
+    ("test_chunked_prefill.py", "TestChunkedInterference"),
     ("test_engine.py", "TestDecodePathParityFuzz"),
     ("test_engine.py", "TestMoEServing"),
     ("test_engine.py", "TestGemmaServing"),
