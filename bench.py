@@ -64,6 +64,15 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
                        prefill + mixed prefill/decode steps; 0/unset =
                        legacy either-or scheduling) — the TTFT/ITL
                        trade-off knob
+  BENCH_TRANSFER=1     cross-pod KV transfer for the precise policy: the
+                       BlendedRouter runs with the transfer cost model and
+                       a "pull" decision actually moves the prefix blocks
+                       (source export → target import through the real
+                       engine endpoints), charging the target's virtual
+                       clock with the measured wall time plus modeled link
+                       time; pull counts land in the detail JSON
+  BENCH_TRANSFER_GBPS=N  modeled DCN link rate for the pull charge and the
+                       cost model's seed transfer rate (default 10)
 """
 
 from __future__ import annotations
@@ -326,6 +335,36 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
                 ],
             )
 
+    # Cross-pod KV transfer arm (BENCH_TRANSFER=1, precise only): the
+    # router runs with the transfer cost model, and a "pull" decision
+    # actually moves the blocks through the real engine export/import
+    # endpoints. The pull is charged end-to-end to the TARGET pod's
+    # virtual clock: measured export+import wall time (the real gather/
+    # scatter cost on this rig) plus wire_bytes / BENCH_TRANSFER_GBPS
+    # (the DCN hop an in-process co-sim cannot measure).
+    cost_model = None
+    link_bytes_s = 0.0
+    pull_stats = {"pulls": 0, "pulled_blocks": 0, "pull_s": 0.0}
+    if blended is not None and os.environ.get("BENCH_TRANSFER", "0") == "1":
+        from llm_d_kv_cache_manager_tpu.kvcache.transfer import (
+            TransferCostModel,
+            TransferCostModelConfig,
+        )
+
+        link_bytes_s = (
+            float(os.environ.get("BENCH_TRANSFER_GBPS", "10")) * 1e9 / 8
+        )
+        cost_model = TransferCostModel(
+            TransferCostModelConfig(
+                block_bytes=pods[0].engine.kv_block_bytes, block_size=page
+            )
+        )
+        # Seed the link rate so the first pull can happen at all (the
+        # EMA then blends in measured end-to-end samples); prefill rate
+        # feeds from the engines' own online EMAs per arrival.
+        cost_model.seed_rates(transfer_bytes_s=link_bytes_s)
+        blended.cost_model = cost_model
+
     ttfts: dict[int, float] = {}
     arrivals: dict[int, float] = {}
     segments: dict[int, int] = {}
@@ -343,7 +382,34 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
             # that fixed the measured cold-index scatter under thrash,
             # results/routing_capacity.md round 4).
             bus.release(t)
-            best = pod_names.index(blended.route(tokens, pod_names, now=t).pod)
+            if cost_model is not None:
+                rates = [
+                    p.engine._prefill_rate
+                    for p in pods
+                    if p.engine._prefill_rate
+                ]
+                if rates:
+                    cost_model.seed_rates(
+                        prefill_tokens_s=float(np.median(rates))
+                    )
+            decision = blended.route(tokens, pod_names, now=t)
+            best = pod_names.index(decision.pod)
+            if decision.action == "pull" and decision.pull_source is not None:
+                src = pods[pod_names.index(decision.pull_source)]
+                tgt = pods[best]
+                hashes = indexer.token_processor.prefix_hashes(tokens)
+                t0 = time.perf_counter()
+                blocks = src.engine.export_kv_blocks(hashes)
+                n_imp = tgt.engine.import_kv_blocks(blocks)
+                wall = time.perf_counter() - t0
+                wire = sum(b.wire_bytes for b in blocks)
+                link_s = wire / link_bytes_s if wire and link_bytes_s else 0.0
+                tgt.clock = max(tgt.clock, t) + wall + link_s
+                if wire:
+                    cost_model.observe_transfer(wire, wall + link_s)
+                pull_stats["pulls"] += 1
+                pull_stats["pulled_blocks"] += n_imp
+                pull_stats["pull_s"] += wall + link_s
         elif policy == "estimated":
             keys = est.keys(tokens)
             best = max(
@@ -413,6 +479,12 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
         # means wall-time wedges were clamped out of the virtual clocks.
         "stall_clamped_s": round(stall_clamped_s, 3),
         "stall_clamped_steps": stall_clamped_steps,
+        # Cross-pod pull accounting (BENCH_TRANSFER=1, precise only).
+        **(
+            {"transfer": {**pull_stats, "pull_s": round(pull_stats["pull_s"], 3)}}
+            if cost_model is not None
+            else {}
+        ),
     }
 
 
@@ -645,6 +717,7 @@ def main() -> int:
         "host_pages": host_pages,
         "total_pages": total_pages,
         "chunked_prefill_tokens": chunked if chunked > 0 else None,
+        "transfer": os.environ.get("BENCH_TRANSFER", "0") == "1",
         "event_lag_ms": float(os.environ.get("BENCH_EVENT_LAG_MS", "2")),
         "qps_ramp": [round(q, 2) for q in qps_ramp],
         "results": results,

@@ -1,4 +1,5 @@
 from . import kvblock  # noqa: F401
+from . import transfer  # noqa: F401
 from .indexer import KVCacheIndexer, KVCacheIndexerConfig
 from .router import BlendedRouter, PrefixAffinityTracker, RoutingDecision
 from .scorer import (
@@ -14,6 +15,7 @@ __all__ = [
     "PrefixAffinityTracker",
     "RoutingDecision",
     "kvblock",
+    "transfer",
     "KVCacheIndexer",
     "KVCacheIndexerConfig",
     "KVBlockScorer",

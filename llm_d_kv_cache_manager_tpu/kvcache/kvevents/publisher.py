@@ -31,6 +31,13 @@ class ZMQPublisherConfig:
     data_parallel_rank: Optional[int] = None
 
 
+#: bounded send retries: the index tolerates lost batches (LRU staleness
+#: model), so after these attempts the batch is DROPPED — a transient
+#: socket error must never raise into the engine loop and kill serving.
+_SEND_ATTEMPTS = 3
+_SEND_BACKOFF_S = 0.05
+
+
 class ZMQPublisher:
     def __init__(self, config: ZMQPublisherConfig):
         import zmq
@@ -41,10 +48,16 @@ class ZMQPublisher:
         self._sock.connect(config.endpoint)
         self._seq = 0
         self._mu = threading.Lock()
+        self._closed = False
+        self.dropped_batches = 0
         self.topic = f"kv@{config.pod_identifier}@{config.model_name}"
 
     def publish(self, events: list[Event], ts: Optional[float] = None) -> int:
-        """Publish one EventBatch; returns the sequence number used."""
+        """Publish one EventBatch; returns the sequence number used (-1
+        when the publisher is closed or the batch was dropped after
+        bounded retries — the subscriber's seq gaps flag the loss)."""
+        import zmq
+
         batch = EventBatch(
             ts=ts if ts is not None else time.time(),
             events=events,
@@ -52,12 +65,36 @@ class ZMQPublisher:
         )
         payload = batch.to_payload()
         with self._mu:
+            if self._closed:
+                log.warning("publish after close; dropping batch")
+                self.dropped_batches += 1
+                return -1
             seq = self._seq
             self._seq += 1
-            self._sock.send_multipart(
-                [self.topic.encode("utf-8"), struct.pack(">Q", seq), payload]
-            )
-        return seq
+            frames = [self.topic.encode("utf-8"), struct.pack(">Q", seq), payload]
+            for attempt in range(_SEND_ATTEMPTS):
+                try:
+                    self._sock.send_multipart(frames)
+                    return seq
+                except zmq.ZMQError as e:
+                    if attempt + 1 == _SEND_ATTEMPTS:
+                        # Give up: the engine loop must keep serving; the
+                        # index self-heals via LRU staleness.
+                        self.dropped_batches += 1
+                        log.error(
+                            "dropping event batch after retries",
+                            error=repr(e),
+                            attempts=_SEND_ATTEMPTS,
+                            seq=seq,
+                        )
+                        return -1
+                    time.sleep(_SEND_BACKOFF_S * (2**attempt))
+        return -1  # unreachable; keeps the contract explicit
 
     def close(self) -> None:
-        self._sock.close(linger=100)
+        """Idempotent: double-close must not hit an already-closed socket."""
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+            self._sock.close(linger=100)

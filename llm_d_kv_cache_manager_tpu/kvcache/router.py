@@ -24,6 +24,14 @@ round-4 section).
 
 Measured at a thrash-sized pool: p90 TTFT 2.51 s vs 5.66 s for pure
 index routing, and −17 % vs the strongest index-free baseline.
+
+Routing toward warmth has a hard limit this module hit in round 4: when
+the warmest pod is overloaded (or a replica joins cold), the best options
+used to be "queue behind the hot pod" or "recompute the whole prefill
+cold". With an optional ``kvcache/transfer`` cost model the router gains
+the third option — MOVE the warmth: ``RoutingDecision.action`` reports
+route-to-warm / pull-then-compute / cold-recompute, decided from measured
+transfer bytes/s vs prefill tokens/s (see ``transfer/cost_model.py``).
 """
 
 from __future__ import annotations
@@ -89,6 +97,14 @@ class RoutingDecision:
     pod: str
     index_score: int
     affinity_score: int
+    #: transfer-aware verdict (kvcache/transfer cost model): "route_warm"
+    #: (serve where the prefix lives — the only action without a cost
+    #: model), "pull" (land on ``pod`` but fetch the warm prefix from
+    #: ``pull_source`` first), or "cold" (land on ``pod``, recompute).
+    action: str = "route_warm"
+    pull_source: Optional[str] = None
+    #: consecutive warm prefix blocks available at ``pull_source``
+    pull_blocks: int = 0
 
 
 class BlendedRouter:
@@ -97,6 +113,15 @@ class BlendedRouter:
     ``score_fn(tokens, pods) -> {pod: score}`` is the index read path
     (e.g. ``KVCacheIndexer.score_tokens`` partially applied with the
     model name); ``loads_fn(pods) -> [outstanding]`` supplies load.
+
+    With a ``cost_model`` (``kvcache/transfer.TransferCostModel``) the
+    router gains a third axis beyond *where warmth is*: whether to MOVE
+    it. When the warmest pod is loaded, the model compares queueing
+    behind it against pulling its prefix blocks onto the least-loaded pod
+    (measured transfer bytes/s vs prefill tokens/s) against plain cold
+    recompute there — the decision rides back on ``RoutingDecision.action``
+    and the caller performs the pull (``PodServer.pull_prefix``). Without
+    a cost model the behavior is bit-identical to the legacy router.
     """
 
     def __init__(
@@ -104,10 +129,12 @@ class BlendedRouter:
         score_fn: Callable,
         affinity: PrefixAffinityTracker,
         loads_fn: Callable[[Sequence[str]], Sequence[float]],
+        cost_model=None,
     ):
         self.score_fn = score_fn
         self.affinity = affinity
         self.loads_fn = loads_fn
+        self.cost_model = cost_model
 
     def route(
         self, tokens: Sequence[int], pods: Sequence[str], now: float = 0.0
@@ -122,11 +149,30 @@ class BlendedRouter:
             range(len(pods)),
             key=lambda i: (scores.get(pods[i], 0), aff_scores[i], -loads[i], -i),
         )
-        self.affinity.record(keys, best, now)
+        target, action, pull_source, pull_blocks = best, "route_warm", None, 0
+        warm_blocks = scores.get(pods[best], 0)
+        if self.cost_model is not None and warm_blocks > 0:
+            coldest = min(range(len(pods)), key=lambda i: (loads[i], i))
+            if coldest != best:
+                verdict = self.cost_model.decide(
+                    prompt_len=len(tokens),
+                    warm_blocks=warm_blocks,
+                    warm_load=loads[best],
+                    cold_load=loads[coldest],
+                )
+                if verdict == "pull":
+                    target, action = coldest, "pull"
+                    pull_source, pull_blocks = pods[best], warm_blocks
+                elif verdict == "cold":
+                    target, action = coldest, "cold"
+        self.affinity.record(keys, target, now)
         # Decision metadata is DECISION-time state (what drove the pick),
         # captured before record() refreshes the affinity memory.
         return RoutingDecision(
-            pod=pods[best],
-            index_score=scores.get(pods[best], 0),
-            affinity_score=aff_scores[best],
+            pod=pods[target],
+            index_score=scores.get(pods[target], 0),
+            affinity_score=aff_scores[target],
+            action=action,
+            pull_source=pull_source,
+            pull_blocks=pull_blocks,
         )

@@ -1,0 +1,44 @@
+"""Cross-pod KV-block transfer: pull warm prefixes instead of recomputing.
+
+The indexer/scorer/router stack can only route *toward* warmth; this
+subsystem moves the KV pages themselves, turning every pod's HBM + host
+tiers into a fleet-wide prefix cache (Mooncake/LMCache-style disaggregated
+KV). Three pieces:
+
+- ``protocol``: msgpack wire format for block-chain fetches (the event
+  plane's framing idioms, applied to bulk page payloads);
+- ``service`` / ``client``: ZMQ ROUTER/DEALER request channel — each pod
+  binds an export service; peers fetch prefix chains by block hash;
+- ``cost_model``: measured bytes/s-vs-tokens/s accounting behind the
+  router's route-to-warm / pull-then-compute / cold-recompute decision.
+
+The engine-side export/import endpoints live in ``server/engine.py`` and
+``server/block_manager.py``; ``server/serve.py`` wires the service into a
+pod (``TRANSFER_ENDPOINT``; off by default = legacy behavior).
+"""
+
+from .client import KVTransferClient, TransferClientConfig, TransferError
+from .cost_model import TransferCostModel, TransferCostModelConfig
+from .protocol import (
+    BlockPayload,
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+)
+from .service import KVTransferService, TransferServiceConfig
+
+__all__ = [
+    "BlockPayload",
+    "KVTransferClient",
+    "KVTransferService",
+    "TransferClientConfig",
+    "TransferCostModel",
+    "TransferCostModelConfig",
+    "TransferError",
+    "TransferServiceConfig",
+    "decode_request",
+    "decode_response",
+    "encode_request",
+    "encode_response",
+]

@@ -1,0 +1,110 @@
+"""Fetch side of the KV-transfer channel: DEALER with bounded timeouts.
+
+A pull is strictly an optimization — every failure mode (dead peer, slow
+link, truncated chain, garbage payload) must degrade to "recompute the
+prefix cold", never wedge or crash the puller. So:
+
+- every ``fetch`` polls with a hard deadline and raises ``TransferError``
+  on expiry;
+- after a timeout the socket is torn down and rebuilt, so a late straggler
+  reply can never be mis-matched to the next request;
+- successful fetches report ``(wire_bytes, seconds)`` to ``on_sample`` —
+  the measured-link feed of the router's transfer-vs-recompute cost model.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from ...utils import get_logger
+from .protocol import BlockPayload, decode_response, encode_request
+
+log = get_logger("kvcache.transfer.client")
+
+
+class TransferError(RuntimeError):
+    """A fetch failed (timeout, service error, undecodable reply)."""
+
+
+@dataclass
+class TransferClientConfig:
+    endpoint: str = "tcp://localhost:5558"
+    timeout_s: float = 10.0
+
+
+class KVTransferClient:
+    def __init__(
+        self,
+        config: TransferClientConfig,
+        on_sample: Optional[Callable[[int, float], None]] = None,
+    ):
+        self.config = config
+        self.on_sample = on_sample
+        self._mu = threading.Lock()
+        self._sock = None
+        self._closed = False
+
+    def _socket(self):
+        import zmq
+
+        if self._sock is None:
+            ctx = zmq.Context.instance()
+            self._sock = ctx.socket(zmq.DEALER)
+            self._sock.connect(self.config.endpoint)
+        return self._sock
+
+    def _reset_socket(self) -> None:
+        if self._sock is not None:
+            self._sock.close(linger=0)
+            self._sock = None
+
+    def fetch(
+        self,
+        model_name: str,
+        block_hashes: Sequence[int],
+        max_blocks: Optional[int] = None,
+    ) -> tuple[list[BlockPayload], bool]:
+        """Fetch the longest resident prefix of ``block_hashes`` from the
+        peer. Returns ``(blocks, complete)``; raises ``TransferError`` on
+        timeout/service failure (callers fall back to cold prefill)."""
+        import zmq
+
+        if not block_hashes:
+            return [], True
+        with self._mu:
+            if self._closed:
+                raise TransferError("client closed")
+            sock = self._socket()
+            t0 = time.perf_counter()
+            try:
+                sock.send(encode_request(model_name, block_hashes, max_blocks))
+                if not sock.poll(int(self.config.timeout_s * 1000), zmq.POLLIN):
+                    self._reset_socket()  # a late reply must not leak forward
+                    raise TransferError(
+                        f"fetch timed out after {self.config.timeout_s}s "
+                        f"({self.config.endpoint})"
+                    )
+                frames = sock.recv_multipart()
+            except zmq.ZMQError as e:
+                self._reset_socket()
+                raise TransferError(f"fetch failed: {e}") from e
+            dt = time.perf_counter() - t0
+        decoded = decode_response(frames[-1])
+        if decoded is None:
+            raise TransferError("undecodable transfer response")
+        blocks, complete, error = decoded
+        if error is not None:
+            raise TransferError(f"peer refused fetch: {error}")
+        if self.on_sample is not None and blocks:
+            self.on_sample(sum(b.wire_bytes for b in blocks), dt)
+        return blocks, complete
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+            self._reset_socket()

@@ -1,0 +1,165 @@
+"""KV-transfer wire format: msgpack-framed block-chain fetches.
+
+Same framing discipline as the event plane (``kvevents/events.py``):
+array-encoded tagged unions, positional and tolerant decoding (missing
+trailing fields default, malformed messages decode to ``None`` rather than
+raising — a poison request must never kill the export service).
+
+- request: ``["FetchBlocks", model_name, [block_hash, ...], max_blocks]``
+- response: ``["Blocks", complete, [[hash, parent_hash, token_ids,
+  block_size, dtype, shape, k_data, v_data], ...]]``
+- error: ``["TransferError", message]``
+
+Hashes are uint64 (the sha256-CBOR chain the whole system keys on); page
+payloads ride as raw bytes of the engine's ``[n_layers, page_size,
+n_kv_heads, head_dim]`` page slice, dtype/shape-tagged so the importer can
+verify geometry before committing anything.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import msgpack
+
+FETCH_BLOCKS_TAG = "FetchBlocks"
+BLOCKS_TAG = "Blocks"
+ERROR_TAG = "TransferError"
+
+
+@dataclass
+class BlockPayload:
+    """One transferable KV block: chain identity + page bytes."""
+
+    block_hash: int
+    parent_block_hash: Optional[int]
+    token_ids: list[int]
+    block_size: int
+    dtype: str
+    #: per-page slice shape: (n_layers, page_size, n_kv_heads, head_dim)
+    shape: tuple[int, ...]
+    k_data: bytes
+    v_data: bytes
+
+    @property
+    def wire_bytes(self) -> int:
+        return len(self.k_data) + len(self.v_data)
+
+
+def encode_request(
+    model_name: str, block_hashes: Sequence[int], max_blocks: Optional[int] = None
+) -> bytes:
+    return msgpack.packb(
+        [FETCH_BLOCKS_TAG, model_name, [int(h) for h in block_hashes], max_blocks],
+        use_bin_type=True,
+    )
+
+
+def decode_request(payload: bytes) -> Optional[tuple[str, list[int], Optional[int]]]:
+    """``(model_name, block_hashes, max_blocks)`` or None for garbage."""
+    arr = _unpack(payload)
+    if (
+        not isinstance(arr, (list, tuple))
+        or len(arr) < 3
+        or _text(arr[0]) != FETCH_BLOCKS_TAG
+        or not isinstance(arr[2], (list, tuple))
+    ):
+        return None
+    model = _text(arr[1])
+    if not isinstance(model, str) or not model:
+        return None
+    try:
+        hashes = [int(h) for h in arr[2]]
+    except (TypeError, ValueError):
+        return None
+    max_blocks = arr[3] if len(arr) > 3 else None
+    if max_blocks is not None:
+        try:
+            max_blocks = int(max_blocks)
+        except (TypeError, ValueError):
+            return None
+    return model, hashes, max_blocks
+
+
+def encode_response(blocks: Sequence[BlockPayload], complete: bool) -> bytes:
+    arr = [
+        BLOCKS_TAG,
+        bool(complete),
+        [
+            [
+                b.block_hash,
+                b.parent_block_hash,
+                list(b.token_ids),
+                b.block_size,
+                b.dtype,
+                list(b.shape),
+                b.k_data,
+                b.v_data,
+            ]
+            for b in blocks
+        ],
+    ]
+    return msgpack.packb(arr, use_bin_type=True)
+
+
+def encode_error(message: str) -> bytes:
+    return msgpack.packb([ERROR_TAG, message], use_bin_type=True)
+
+
+def decode_response(
+    payload: bytes,
+) -> Optional[tuple[list[BlockPayload], bool, Optional[str]]]:
+    """``(blocks, complete, error)``; ``error`` set for service-side
+    failures, None return for undecodable payloads."""
+    arr = _unpack(payload)
+    if not isinstance(arr, (list, tuple)) or not arr:
+        return None
+    tag = _text(arr[0])
+    if tag == ERROR_TAG:
+        return [], False, _text(arr[1]) if len(arr) > 1 else "unknown error"
+    if tag != BLOCKS_TAG or len(arr) < 3 or not isinstance(arr[2], (list, tuple)):
+        return None
+    blocks: list[BlockPayload] = []
+    for raw in arr[2]:
+        blk = _decode_block(raw)
+        if blk is None:
+            return None  # a half-garbled block corrupts the chain: reject all
+        blocks.append(blk)
+    return blocks, bool(arr[1]), None
+
+
+def _decode_block(raw: Any) -> Optional[BlockPayload]:
+    if not isinstance(raw, (list, tuple)) or len(raw) < 8:
+        return None
+    (h, parent, token_ids, block_size, dtype, shape, k_data, v_data) = raw[:8]
+    if not isinstance(k_data, (bytes, bytearray)) or not isinstance(
+        v_data, (bytes, bytearray)
+    ):
+        return None
+    try:
+        return BlockPayload(
+            block_hash=int(h),
+            parent_block_hash=None if parent is None else int(parent),
+            token_ids=[int(t) for t in (token_ids or [])],
+            block_size=int(block_size),
+            dtype=_text(dtype) or "",
+            shape=tuple(int(d) for d in (shape or ())),
+            k_data=bytes(k_data),
+            v_data=bytes(v_data),
+        )
+    except (TypeError, ValueError):
+        return None
+
+
+def _unpack(payload: bytes) -> Any:
+    try:
+        return msgpack.unpackb(payload, raw=False)
+    except Exception:
+        return None
+
+
+def _text(v: Any) -> Any:
+    if isinstance(v, (bytes, bytearray)):
+        return v.decode("utf-8", "replace")
+    return v

@@ -1,0 +1,146 @@
+"""Page export service: ZMQ ROUTER answering block-chain fetches.
+
+Each pod binds one ROUTER socket (``TRANSFER_ENDPOINT``); peer pods connect
+DEALERs and request prefix chains by block hash. Transport idioms follow
+``kvevents/zmq_subscriber.py``: the stable side binds, poll with a short
+timeout so shutdown stays responsive, reconnect forever with backoff on
+socket errors, and never let a malformed request kill the loop.
+
+The service itself owns no KV state — the ``handler`` callback
+(``(block_hashes, max_blocks) -> list[BlockPayload]``) is supplied by the
+pod server, which bridges onto the engine loop thread (the only thread
+allowed to touch page pools). Responses are length-capped twice: at
+``max_blocks`` (request cap is clamped to the service's own) and at
+``max_reply_bytes`` of page payload, so one fetch can never wedge the
+socket with an unbounded chain.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from ...utils import get_logger
+from .protocol import BlockPayload, decode_request, encode_error, encode_response
+
+log = get_logger("kvcache.transfer.service")
+
+_POLL_TIMEOUT_MS = 250
+_RECONNECT_BACKOFF_S = 5.0
+
+
+@dataclass
+class TransferServiceConfig:
+    endpoint: str = "tcp://*:5558"
+    model_name: str = "unknown-model"
+    #: hard cap on blocks per response (requests may ask for fewer)
+    max_blocks: int = 64
+    #: hard cap on page-payload bytes per response
+    max_reply_bytes: int = 256 << 20
+
+
+class KVTransferService:
+    """Binds a ROUTER socket and serves prefix-chain fetches."""
+
+    def __init__(
+        self,
+        config: TransferServiceConfig,
+        handler: Callable[[list[int], int], Sequence[BlockPayload]],
+    ):
+        self.config = config
+        self.handler = handler
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: observability, read by /stats
+        self.requests_served = 0
+        self.blocks_served = 0
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="kv-transfer-service", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- internals ----------------------------------------------------------
+    def _run(self) -> None:
+        import zmq
+
+        ctx = zmq.Context.instance()
+        while not self._stop.is_set():
+            try:
+                self._serve(ctx)
+            except Exception:
+                log.exception(
+                    "transfer service failed; rebinding",
+                    backoff_s=_RECONNECT_BACKOFF_S,
+                )
+                if self._stop.wait(_RECONNECT_BACKOFF_S):
+                    return
+
+    def _serve(self, ctx) -> None:
+        import zmq
+
+        sock = ctx.socket(zmq.ROUTER)
+        try:
+            sock.bind(self.config.endpoint)
+            log.info(
+                "kv transfer service listening",
+                endpoint=self.config.endpoint,
+                max_blocks=self.config.max_blocks,
+            )
+            poller = zmq.Poller()
+            poller.register(sock, zmq.POLLIN)
+            while not self._stop.is_set():
+                if not dict(poller.poll(_POLL_TIMEOUT_MS)):
+                    continue
+                frames = sock.recv_multipart()
+                if len(frames) < 2:
+                    log.debug("dropping short transfer request", n=len(frames))
+                    continue
+                ident, payload = frames[0], frames[-1]
+                sock.send_multipart([ident, self._handle(payload)])
+        finally:
+            sock.close(linger=0)
+
+    def _handle(self, payload: bytes) -> bytes:
+        req = decode_request(payload)
+        if req is None:
+            return encode_error("malformed request")
+        model, hashes, max_blocks = req
+        if model != self.config.model_name:
+            return encode_error(
+                f"model mismatch: serving {self.config.model_name!r}"
+            )
+        cap = self.config.max_blocks
+        if max_blocks is not None and max_blocks > 0:
+            cap = min(cap, max_blocks)
+        try:
+            blocks = list(self.handler(hashes[:cap], cap))
+        except Exception as e:
+            log.exception("transfer handler failed")
+            return encode_error(f"export failed: {type(e).__name__}")
+        blocks, complete = self._cap_bytes(blocks, len(hashes))
+        self.requests_served += 1
+        self.blocks_served += len(blocks)
+        return encode_response(blocks, complete)
+
+    def _cap_bytes(
+        self, blocks: list[BlockPayload], n_requested: int
+    ) -> tuple[list[BlockPayload], bool]:
+        total = 0
+        for i, blk in enumerate(blocks):
+            total += blk.wire_bytes
+            if total > self.config.max_reply_bytes and i > 0:
+                # Truncate, never drop block 0: a response must always make
+                # progress or the client would retry the same oversize ask.
+                return blocks[:i], False
+        return blocks, len(blocks) >= n_requested

@@ -257,6 +257,75 @@ class BlockManager:
         )
         return page
 
+    # -- cross-pod transfer (kvcache/transfer) ------------------------------
+    def is_block_resident(self, h: int) -> bool:
+        """True when ``h`` lives in either tier (HBM page or host slot)."""
+        return h in self._cached or h in self._host_cached
+
+    def lookup_chain(
+        self, hashes: Seq[int], max_blocks: Optional[int] = None
+    ) -> list[tuple[int, _PageInfo, str, int]]:
+        """Export read path: walk a chained-hash prefix and return the
+        longest consecutive resident run as ``(hash, info, tier, idx)``
+        tuples — tier ``"tpu_hbm"`` (idx = device page) or ``"host_dram"``
+        (idx = host slot). Stops at the first non-resident hash: a block
+        behind a chain gap can never serve a prefix hit on the importer,
+        so shipping it would be pure waste."""
+        out: list[tuple[int, _PageInfo, str, int]] = []
+        walk = hashes if max_blocks is None else hashes[:max_blocks]
+        for h in walk:
+            page = self._cached.get(h)
+            if page is not None:
+                out.append((h, self._pages[page], "tpu_hbm", page))
+                continue
+            slot = self._host_cached.get(h)
+            if slot is not None:
+                out.append((h, self._host_info[slot], "host_dram", slot))
+                continue
+            break
+        return out
+
+    def install_imported_block(
+        self, h: int, parent_hash: Optional[int], token_ids: Seq[int]
+    ) -> Optional[int]:
+        """Commit a transferred block as a prefix-cache page: allocate a
+        page, register it under ``h`` (ref 0, evictable — imports are
+        warmth, not work-in-flight) and emit ``BlockStored`` so the global
+        index learns this replica now holds the block. Returns the device
+        page the caller must write the KV bytes into, or ``None`` when the
+        block is already resident in some tier (nothing to do).
+
+        Only genuinely FREE pages are used — an import never evicts
+        locally-warm pages (raises ``AllocationError`` instead): evicting
+        proven-warm state for speculative remote warmth would let a pull
+        storm thrash the very cache the transfer plane exists to protect.
+        """
+        if self.is_block_resident(h):
+            return None
+        if not self._free:
+            raise AllocationError("no free pages for imported KV block")
+        page = self._free.pop()
+        info = _PageInfo(
+            ref_count=0,
+            chain_hash=h,
+            token_ids=tuple(int(t) for t in token_ids),
+            parent_hash=parent_hash,
+        )
+        self._pages[page] = info
+        self._cached[h] = page
+        self._evictable[page] = None
+        self._evictable.move_to_end(page)
+        self._emit(
+            BlockStored(
+                block_hashes=[h],
+                parent_block_hash=parent_hash,
+                token_ids=list(info.token_ids),
+                block_size=self.config.page_size,
+                medium="tpu_hbm",
+            )
+        )
+        return page
+
     # -- sequence lifecycle -------------------------------------------------
     def allocate(self, seq: Sequence) -> int:
         """Allocate pages for a sequence's prompt, reusing prefix-cached

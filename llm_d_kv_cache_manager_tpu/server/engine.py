@@ -353,6 +353,16 @@ class Engine:
                 self._offload_rate = n_probe / max(
                     time.perf_counter() - t0, 1e-6
                 )
+        #: prefill observability: tokens actually pushed through prefill
+        #: dispatches (the FLOP proxy — prefix-cache hits and imported
+        #: blocks reduce it) and dispatch count.
+        self.prefill_stats = {"tokens_computed": 0, "dispatches": 0}
+        #: cross-pod KV transfer observability (kvcache/transfer).
+        self.transfer_stats = {
+            "exported_blocks": 0,
+            "imported_blocks": 0,
+            "import_rejected": 0,
+        }
         self._pending_offloads: list = []
         self._pending_restores: list = []
         self._off_by_slot: dict = {}
@@ -493,6 +503,139 @@ class Engine:
         self._pending_restores.clear()
         self._off_by_slot.clear()
         self._restore_by_page.clear()
+
+    # -- cross-pod KV transfer (kvcache/transfer) ---------------------------
+    @property
+    def kv_block_bytes(self) -> int:
+        """Wire bytes of one transferred KV block (k + v page slices) —
+        the ``block_bytes`` feed of the router's transfer cost model."""
+        cfg = self.model_cfg
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        return 2 * cfg.n_layers * self.page_size * cfg.n_kv_heads * cfg.hd * itemsize
+
+    def export_kv_blocks(self, hashes: list, max_blocks: Optional[int] = None):
+        """Serve a peer's prefix fetch: the longest consecutive resident
+        run of ``hashes`` as ``BlockPayload``s, sourced from HBM (one
+        batched gather) and the host-DRAM tier. Must run on the engine
+        thread — it reads page pools and flushes queued page moves so the
+        exported bytes reflect committed state, not in-flight snapshots."""
+        from ..kvcache.transfer.protocol import BlockPayload
+
+        self._flush_page_moves()
+        chain = self.block_manager.lookup_chain(hashes, max_blocks)
+        if not chain:
+            return []
+        dev = [(i, idx) for i, (_, _, tier, idx) in enumerate(chain) if tier == "tpu_hbm"]
+        page_data: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        if dev:
+            # Bucket the gather width to a power of two (the flush path's
+            # rule): peers fetch chains of arbitrary length, and an
+            # unbucketed width would compile a fresh executable per
+            # length — each stalling the engine loop between steps.
+            pages = [p for _, p in dev]
+            n = 1 << (len(pages) - 1).bit_length()
+            idx = jnp.asarray(pages + [pages[0]] * (n - len(pages)), jnp.int32)
+            k = np.asarray(_read_pages_batch(self.k_pages, idx))
+            v = np.asarray(_read_pages_batch(self.v_pages, idx))
+            for j, (i, _) in enumerate(dev):
+                page_data[i] = (k[:, j], v[:, j])
+        blocks = []
+        for i, (h, info, tier, idx) in enumerate(chain):
+            if tier == "tpu_hbm":
+                kd, vd = page_data[i]
+            else:
+                kd, vd = self._host_k[idx], self._host_v[idx]
+            # tobytes() emits C-order bytes from any view — no
+            # ascontiguousarray staging copy.
+            blocks.append(
+                BlockPayload(
+                    block_hash=h,
+                    parent_block_hash=info.parent_hash,
+                    token_ids=list(info.token_ids),
+                    block_size=self.page_size,
+                    dtype=str(kd.dtype),
+                    shape=tuple(kd.shape),
+                    k_data=kd.tobytes(),
+                    v_data=vd.tobytes(),
+                )
+            )
+        self.transfer_stats["exported_blocks"] += len(blocks)
+        return blocks
+
+    def import_kv_blocks(self, blocks) -> int:
+        """Install fetched prefix blocks as committed prefix-cache pages.
+
+        Each block must extend a resident chain (its parent is the chain
+        root, an already-resident block, or the block installed just
+        before it) and match this engine's page geometry exactly — the
+        first violation stops the import (a block behind a gap can never
+        prefix-hit). Page bytes are queued through the same batched-mover
+        path host-tier restores use and land before the next device
+        dispatch, so a subsequent local prefill hits imported pages
+        exactly like locally-computed cache. ``BlockStored`` events flush
+        immediately so the global index learns the new warmth without
+        waiting for engine traffic. Returns the number of blocks
+        installed. Must run on the engine thread."""
+        from ..kvcache.kvblock.token_processor import hash_block
+
+        cfg = self.model_cfg
+        ps = self.page_size
+        expected_shape = (cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
+        np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
+        page_bytes = int(np.prod(expected_shape)) * np_dtype.itemsize
+        installed = 0
+        for blk in blocks:
+            try:
+                blk_dtype = np.dtype(blk.dtype)
+            except TypeError:
+                blk_dtype = None
+            if (
+                blk.block_size != ps
+                or tuple(blk.shape) != expected_shape
+                or blk_dtype != np_dtype
+                or len(blk.token_ids) != ps
+                or len(blk.k_data) != page_bytes
+                or len(blk.v_data) != page_bytes
+            ):
+                self.transfer_stats["import_rejected"] += 1
+                break  # geometry mismatch: nothing later can be valid either
+            h = blk.block_hash
+            if self.block_manager.is_block_resident(h):
+                continue  # local copy wins; chain continuity is preserved
+            parent = blk.parent_block_hash
+            if parent is not None and not self.block_manager.is_block_resident(parent):
+                self.transfer_stats["import_rejected"] += 1
+                break  # chain gap: unreachable by any prefix walk
+            # Verify the chain hash against the tokens the peer claims the
+            # block holds: the prefix cache's truth is this hash chain, so
+            # an entry whose hash this engine would not itself compute
+            # (tampered/corrupt payload, or a hash_seed-misaligned fleet)
+            # must never register. KV bytes are necessarily trusted —
+            # verifying them would be the recompute we are avoiding.
+            chain_parent = (
+                parent if parent is not None else self.block_manager.token_db.init_hash
+            )
+            if hash_block(chain_parent, blk.token_ids) != h:
+                self.transfer_stats["import_rejected"] += 1
+                break
+            try:
+                page = self.block_manager.install_imported_block(
+                    h, parent, blk.token_ids
+                )
+            except AllocationError:
+                break  # pool full: keep what landed, never evict for imports
+            if page is None:
+                continue
+            k = np.frombuffer(blk.k_data, dtype=np_dtype).reshape(expected_shape)
+            v = np.frombuffer(blk.v_data, dtype=np_dtype).reshape(expected_shape)
+            src = ("data", k, v)
+            self._pending_restores.append((page, src))
+            self._restore_by_page[page] = src
+            installed += 1
+        if installed:
+            self.transfer_stats["imported_blocks"] += installed
+            self.block_manager.flush_events()
+        return installed
 
     # -- public API ---------------------------------------------------------
     def add_request(
@@ -649,6 +792,8 @@ class Engine:
             self._prefill_rate,
             float(valid.sum()) / max(time.perf_counter() - t0, 1e-6),
         )
+        self.prefill_stats["tokens_computed"] += int(valid.sum())
+        self.prefill_stats["dispatches"] += 1
         now = time.monotonic()
         finals = [
             seq

@@ -18,7 +18,9 @@ Config comes from env vars mirroring the reference's online service
 (``examples/kv_events/online/main.go:162-209``): ``MODEL_NAME``,
 ``POD_IDENTIFIER``, ``ZMQ_ENDPOINT``, ``BLOCK_SIZE``, ``PYTHONHASHSEED``,
 ``HTTP_PORT``, plus engine sizing (``TOTAL_PAGES``, ``HOST_PAGES``, ``TP``,
-``MAX_MODEL_LEN``, ``DP_RANK``).
+``MAX_MODEL_LEN``, ``DP_RANK``) and the cross-pod KV transfer plane
+(``TRANSFER_ENDPOINT`` binds this pod's page export service — unset = off;
+``TRANSFER_MAX_BLOCKS``, ``TRANSFER_TIMEOUT_S``).
 
 Run: ``python -m llm_d_kv_cache_manager_tpu.server.serve``
 """
@@ -31,10 +33,18 @@ import threading
 import uuid
 from collections import deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..kvcache.kvevents import ZMQPublisher, ZMQPublisherConfig
+from ..kvcache.transfer import (
+    KVTransferClient,
+    KVTransferService,
+    TransferClientConfig,
+    TransferError,
+    TransferServiceConfig,
+)
 from ..models import LlamaConfig
 from ..utils import get_logger
 from .engine import Engine, EngineConfig
@@ -154,6 +164,14 @@ class PodServerConfig:
     publish_events: bool = True
     data_parallel_rank: Optional[int] = None
     http_port: int = 8000
+    #: cross-pod KV transfer: ROUTER bind address for this pod's page
+    #: export service (``tcp://*:5558``-style). None (default) = transfer
+    #: plane off — bit-identical legacy behavior, nothing binds.
+    transfer_endpoint: Optional[str] = None
+    #: cap on blocks per transfer response (both served and pulled)
+    transfer_max_blocks: int = 64
+    #: fetch deadline; an expired pull falls back to cold prefill
+    transfer_timeout_s: float = 10.0
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -166,6 +184,14 @@ class PodServerConfig:
         if "DP_RANK" in os.environ:
             cfg.data_parallel_rank = int(os.environ["DP_RANK"])
         cfg.http_port = int(os.environ.get("HTTP_PORT", cfg.http_port))
+        # Cross-pod KV transfer (unset/empty = off, legacy behavior).
+        cfg.transfer_endpoint = os.environ.get("TRANSFER_ENDPOINT") or None
+        cfg.transfer_max_blocks = int(
+            os.environ.get("TRANSFER_MAX_BLOCKS", cfg.transfer_max_blocks)
+        )
+        cfg.transfer_timeout_s = float(
+            os.environ.get("TRANSFER_TIMEOUT_S", cfg.transfer_timeout_s)
+        )
 
         eng = cfg.engine
         eng.block_manager = BlockManagerConfig(
@@ -234,9 +260,17 @@ class PodServer:
         engine: Optional[Engine] = None,
         tokenizer=None,
         publisher: Optional[ZMQPublisher] = None,
+        transfer_cost_model=None,
     ):
+        """``transfer_cost_model``: the router's shared
+        ``kvcache/transfer.TransferCostModel``, when this pod participates
+        in transfer-aware routing. The pod feeds it the two measured rates
+        the decide() arms need — transfer bytes/s from every fetch this
+        pod performs, prefill tokens/s from the engine's own online EMA —
+        so the model's pull/cold branches can ever activate."""
         self.config = config or PodServerConfig()
         self._tokenizer = tokenizer
+        self.transfer_cost_model = transfer_cost_model
 
         self._publisher = publisher
         if self._publisher is None and self.config.publish_events:
@@ -267,6 +301,26 @@ class PodServer:
         self._failed: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
 
+        # -- cross-pod KV transfer plane (off unless configured) -----------
+        # Export requests and imports stage onto the ENGINE LOOP, the only
+        # thread allowed to touch page pools (the service/HTTP threads just
+        # park on a Future) — same ownership rule as request admission.
+        self._transfer_exports: deque[tuple[list[int], Optional[int], Future]] = deque()
+        self._transfer_imports: deque[tuple[list, Future]] = deque()
+        self._transfer_clients: dict[str, KVTransferClient] = {}
+        self._transfer_service: Optional[KVTransferService] = None
+        self.transfer_pulls = 0  # pulls that imported >= 1 block
+        self.transfer_pull_failures = 0  # fetch/import fell back to cold
+        if self.config.transfer_endpoint:
+            self._transfer_service = KVTransferService(
+                TransferServiceConfig(
+                    endpoint=self.config.transfer_endpoint,
+                    model_name=self.config.model_name,
+                    max_blocks=self.config.transfer_max_blocks,
+                ),
+                handler=self._serve_export,
+            )
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         with self._mu:
@@ -277,8 +331,12 @@ class PodServer:
             target=self._engine_loop, name="engine-loop", daemon=True
         )
         self._thread.start()
+        if self._transfer_service is not None:
+            self._transfer_service.start()
 
     def shutdown(self) -> None:
+        if self._transfer_service is not None:
+            self._transfer_service.shutdown()
         with self._work:
             self._running = False
             self._work.notify_all()
@@ -286,6 +344,11 @@ class PodServer:
             self._thread.join(timeout=30)
             self._thread = None
         self._fail_outstanding(RuntimeError("pod server shut down"))
+        with self._mu:
+            clients = list(self._transfer_clients.values())
+            self._transfer_clients.clear()
+        for client in clients:
+            client.close()
         if self._publisher is not None:
             self._publisher.close()
 
@@ -293,7 +356,14 @@ class PodServer:
         with self._mu:
             staged = list(self._staging)
             self._staging.clear()
+            transfers = list(self._transfer_exports) + list(self._transfer_imports)
+            self._transfer_exports.clear()
+            self._transfer_imports.clear()
         for _, _, fut in staged:
+            if not fut.done():
+                fut.set_exception(exc)
+        for item in transfers:
+            fut = item[-1]
             if not fut.done():
                 fut.set_exception(exc)
         for fut in list(self._futures.values()):
@@ -306,15 +376,36 @@ class PodServer:
             while True:
                 with self._work:
                     while self._running and not (
-                        self._staging or self.engine.has_work
+                        self._staging
+                        or self._transfer_exports
+                        or self._transfer_imports
+                        or self.engine.has_work
                     ):
                         self._work.wait(timeout=0.1)
                     if not self._running:
                         return
                     staged = list(self._staging)
                     self._staging.clear()
+                    exports = list(self._transfer_exports)
+                    self._transfer_exports.clear()
+                    imports = list(self._transfer_imports)
+                    self._transfer_imports.clear()
                 # Engine state is owned by this thread — no lock held while
                 # admitting or stepping (device compute can take a while).
+                # Imports land before admissions so a request staged with
+                # its pull (pull_prefix -> submit) sees the warm pages.
+                for blocks, fut in imports:
+                    try:
+                        fut.set_result(self.engine.import_kv_blocks(blocks))
+                    except Exception as e:
+                        fut.set_exception(e)
+                for hashes, max_blocks, fut in exports:
+                    try:
+                        fut.set_result(
+                            self.engine.export_kv_blocks(hashes, max_blocks)
+                        )
+                    except Exception as e:
+                        fut.set_exception(e)
                 for tokens, sampling, fut in staged:
                     try:
                         seq = self.engine.add_request(
@@ -326,6 +417,15 @@ class PodServer:
                     self._futures[seq.seq_id] = fut
                 if self.engine.has_work:
                     finished = self.engine.step()
+                    if (
+                        self.transfer_cost_model is not None
+                        and self.engine._prefill_rate
+                    ):
+                        # Prefill-rate feed for the transfer decision: the
+                        # engine's own online EMA, re-pinned per step.
+                        self.transfer_cost_model.seed_rates(
+                            prefill_tokens_s=self.engine._prefill_rate
+                        )
                     self.metrics.sync_spec_stats(self.engine.spec_stats)
                     for seq in finished:
                         self.metrics.observe_finished(seq)
@@ -336,6 +436,87 @@ class PodServer:
             log.error("engine loop died", error=repr(e))
             self._failed = f"{type(e).__name__}: {e}"
             self._fail_outstanding(RuntimeError(f"engine failed: {self._failed}"))
+
+    # -- cross-pod KV transfer ----------------------------------------------
+    def _observe_transfer_sample(self, n_bytes: int, seconds: float) -> None:
+        """KVTransferClient.on_sample → the router's cost model (when this
+        pod participates in transfer-aware routing)."""
+        if self.transfer_cost_model is not None:
+            self.transfer_cost_model.observe_transfer(n_bytes, seconds)
+
+    def _serve_export(self, hashes: list[int], max_blocks: int) -> list:
+        """KVTransferService handler (service thread): hop onto the engine
+        loop — the only thread allowed to read page pools — and wait."""
+        fut: Future = Future()
+        with self._work:
+            if not self._running or self._failed is not None:
+                return []
+            self._transfer_exports.append((hashes, max_blocks, fut))
+            self._work.notify()
+        return fut.result(timeout=max(self.config.transfer_timeout_s * 3, 30.0))
+
+    def submit_import(self, blocks: list) -> Future:
+        """Stage fetched blocks for installation on the engine loop; the
+        Future resolves to the number of blocks imported."""
+        fut: Future = Future()
+        with self._work:
+            if self._failed is not None:
+                raise RuntimeError(f"engine failed: {self._failed}")
+            if not self._running:
+                raise RuntimeError("pod server not running")
+            self._transfer_imports.append((blocks, fut))
+            self._work.notify()
+        return fut
+
+    def pull_prefix(
+        self,
+        prompt_tokens: list[int],
+        source_endpoint: str,
+        timeout_s: Optional[float] = None,
+    ) -> int:
+        """Pull ``prompt_tokens``' warm prefix from a peer pod's export
+        service and commit it locally (the router's "pull-then-compute"
+        arm). Returns blocks imported; 0 on ANY failure — a pull is an
+        optimization, so every error degrades to cold prefill, never to a
+        failed request."""
+        hashes = self.engine.block_manager.token_db.prefix_hashes(prompt_tokens)
+        if not hashes:
+            return 0
+        with self._mu:  # pull_prefix races shutdown's client sweep
+            if not self._running:
+                return 0  # a client created post-sweep would leak its socket
+            client = self._transfer_clients.get(source_endpoint)
+            if client is None:
+                client = KVTransferClient(
+                    TransferClientConfig(
+                        endpoint=source_endpoint,
+                        timeout_s=self.config.transfer_timeout_s,
+                    ),
+                    on_sample=self._observe_transfer_sample,
+                )
+                self._transfer_clients[source_endpoint] = client
+        try:
+            blocks, _complete = client.fetch(
+                self.config.model_name, hashes, self.config.transfer_max_blocks
+            )
+            imported = (
+                self.submit_import(blocks).result(
+                    timeout=timeout_s or self.config.transfer_timeout_s * 3
+                )
+                if blocks
+                else 0
+            )
+        except (TransferError, RuntimeError, FuturesTimeout) as e:
+            self.transfer_pull_failures += 1
+            log.warning(
+                "KV pull failed; falling back to cold prefill",
+                source=source_endpoint,
+                error=repr(e),
+            )
+            return 0
+        if imported:
+            self.transfer_pulls += 1
+        return imported
 
     # -- request path -------------------------------------------------------
     def submit(
@@ -469,6 +650,18 @@ class PodServer:
                 "running": len(self.engine.scheduler.running),
                 "free_pages": bm.num_free,
                 "total_pages": bm.config.total_pages,
+                "prefill": dict(self.engine.prefill_stats),
+                "transfer": {
+                    **self.engine.transfer_stats,
+                    "endpoint": self.config.transfer_endpoint,
+                    "pulls": self.transfer_pulls,
+                    "pull_failures": self.transfer_pull_failures,
+                    "requests_served": (
+                        self._transfer_service.requests_served
+                        if self._transfer_service
+                        else 0
+                    ),
+                },
             }
             return web.json_response(payload)
 

@@ -330,6 +330,104 @@ class TestBlockManagerUnit:
         assert bm.allocate(c2) == PS  # first block restored from host tier
 
 
+class TestBlockManagerHostTierEdges:
+    """Bookkeeping edges of the host-DRAM tier, driven through fake movers:
+    spills into a FULL host tier, and the bring-back path racing host-LRU
+    eviction (block_manager.py::_try_restore's claim-before-alloc rule)."""
+
+    @staticmethod
+    def _bm(total_pages=3, host_pages=1):
+        captured = []
+        bm = BlockManager(
+            BlockManagerConfig(
+                total_pages=total_pages, page_size=PS, host_pages=host_pages
+            ),
+            on_events=captured.extend,
+        )
+        copy_outs, copy_ins = [], []
+        bm.attach_host_pool(
+            copy_out=lambda page, slot: copy_outs.append((page, slot)),
+            copy_in=lambda slot, page: copy_ins.append((slot, page)),
+        )
+        return bm, captured, copy_outs, copy_ins
+
+    @staticmethod
+    def _fill_and_free(bm, tokens):
+        """Allocate a one-page sequence, register its block, free it —
+        leaving the page evictable under its chain hash."""
+        seq = Sequence(prompt_tokens=list(tokens))
+        bm.allocate(seq)
+        seq.num_computed = len(tokens)
+        bm.register_full_pages(seq)
+        bm.free_sequence(seq)
+        bm.flush_events()
+        return bm.token_db.prefix_hashes(tokens)[0]
+
+    def test_offload_into_full_host_tier_evicts_host_lru(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents.events import (
+            BlockRemoved,
+            BlockStored,
+        )
+
+        bm, captured, copy_outs, _ = self._bm()
+        h_a = self._fill_and_free(bm, range(PS))
+        h_b = self._fill_and_free(bm, range(100, 100 + PS))
+        # Recycling A's page spills it into the single host slot.
+        self._fill_and_free(bm, range(200, 200 + PS))
+        assert bm._host_cached == {h_a: 0}
+
+        # Recycling B's page finds the tier FULL: the host LRU (A) must be
+        # evicted — with a truthful host_dram BlockRemoved — and B spilled
+        # into the freed slot.
+        captured.clear()
+        self._fill_and_free(bm, range(300, 300 + PS))
+        assert bm.num_host_cached_pages == 1 and bm._host_cached == {h_b: 0}
+        host_evs = [e for e in captured if e.medium == "host_dram"]
+        assert isinstance(host_evs[0], BlockRemoved)
+        assert host_evs[0].block_hashes == [h_a]
+        assert isinstance(host_evs[1], BlockStored)
+        assert host_evs[1].block_hashes == [h_b]
+        assert copy_outs == [(1, 0), (2, 0)]  # A's page, then B's reused slot
+
+    def test_bring_back_races_host_lru_eviction(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents.events import (
+            BlockStored,
+        )
+
+        bm, captured, copy_outs, copy_ins = self._bm()
+        a_tokens = list(range(PS))
+        h_a = self._fill_and_free(bm, a_tokens)
+        h_b = self._fill_and_free(bm, range(100, 100 + PS))
+        self._fill_and_free(bm, range(200, 200 + PS))  # spills A to slot 0
+        assert bm._host_cached == {h_a: 0}
+        assert copy_outs == [(1, 0)]
+
+        # Bring A back while the pool is exhausted: the restore's
+        # _pop_free_page recycles B's page, whose spill wants a host slot —
+        # and the only slot is the one A is being restored FROM. The claim
+        # taken before allocation must make that spill skip (B's KV is
+        # dropped, truthfully), never corrupt the in-flight restore.
+        captured.clear()
+        seq = Sequence(prompt_tokens=a_tokens + list(range(400, 400 + PS)))
+        assert bm.allocate(seq) == PS  # A restored from the host tier
+        bm.flush_events()
+        assert copy_ins == [(0, 2)]  # restored into B's recycled page
+        # B was never spilled into the mid-restore slot...
+        assert (2, 0) not in copy_outs
+        assert not any(
+            isinstance(e, BlockStored)
+            and e.medium == "host_dram"
+            and e.block_hashes == [h_b]
+            for e in captured
+        )
+        # ...and after the restore freed the slot, the page recycled for
+        # the sequence's second block (C's) spilled into it normally.
+        assert bm._host_cached and 0 in bm._host_cached.values()
+        assert h_b not in bm._host_cached
+        # A is resident again under its hash, referenced by the sequence.
+        assert bm._cached[h_a] == seq.block_table[0]
+
+
 class TestFusedDecode:
     """decode_steps_per_iter > 1: device-resident multi-token decode."""
 

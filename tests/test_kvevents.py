@@ -282,6 +282,69 @@ class TestZMQEndToEnd:
             indexer.shutdown()
 
 
+class TestPublisherHardening:
+    """ISSUE 2 satellite: idempotent close and bounded send retry/backoff —
+    a transient socket error must never raise into the engine loop."""
+
+    @staticmethod
+    def _pub():
+        from conftest import free_tcp_port
+
+        return ZMQPublisher(
+            ZMQPublisherConfig(endpoint=f"tcp://localhost:{free_tcp_port()}")
+        )
+
+    def test_double_close_is_idempotent(self):
+        pub = self._pub()
+        pub.close()
+        pub.close()  # second close must not hit the closed socket
+
+    def test_publish_after_close_drops_without_raising(self):
+        pub = self._pub()
+        pub.close()
+        assert pub.publish([BlockStored(block_hashes=[1], block_size=4)]) == -1
+        assert pub.dropped_batches == 1
+
+    def test_send_failure_retries_then_succeeds(self, monkeypatch):
+        import zmq
+
+        pub = self._pub()
+        calls = []
+
+        def flaky(frames):
+            calls.append(frames)
+            if len(calls) < 3:
+                raise zmq.ZMQError()
+
+        monkeypatch.setattr(pub._sock, "send_multipart", flaky)
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        seq = pub.publish([BlockStored(block_hashes=[1], block_size=4)])
+        assert seq == 0 and len(calls) == 3
+        assert pub.dropped_batches == 0
+        pub.close()
+
+    def test_send_failure_bounded_then_drops(self, monkeypatch):
+        import zmq
+
+        pub = self._pub()
+        calls = []
+
+        def dead(frames):
+            calls.append(frames)
+            raise zmq.ZMQError()
+
+        monkeypatch.setattr(pub._sock, "send_multipart", dead)
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        # Never raises into the caller; attempts are bounded; the batch is
+        # dropped and counted. The next publish still works (and keeps its
+        # own seq, so subscribers see the gap).
+        assert pub.publish([BlockStored(block_hashes=[1], block_size=4)]) == -1
+        assert len(calls) == 3 and pub.dropped_batches == 1
+        monkeypatch.setattr(pub._sock, "send_multipart", lambda frames: None)
+        assert pub.publish([BlockStored(block_hashes=[2], block_size=4)]) == 1
+        pub.close()
+
+
 class TestZMQReconnect:
     """Failure-detection parity (SURVEY §5): the subscriber reconnects with
     backoff after socket errors — here the endpoint is initially occupied by
