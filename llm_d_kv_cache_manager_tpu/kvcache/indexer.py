@@ -52,8 +52,14 @@ class KVCacheIndexer:
         index: Optional[Index] = None,
         tokenizer: Optional[Tokenizer] = None,
         prefix_store: Optional[PrefixStoreIndexer] = None,
+        fleet_health=None,
     ):
+        """``fleet_health`` (a ``kvevents.FleetHealth``, optional): when
+        attached, every score map is filtered through its TTL view so a
+        pod past ``pod_ttl_s`` is never returned to the router — even in
+        the window between expiry and the dead-pod sweep landing."""
         self.config = config or KVCacheIndexerConfig()
+        self.fleet_health = fleet_health
         self.token_processor = ChunkedTokenDatabase(self.config.token_processor)
         self.kv_block_index: Index = (
             index if index is not None else create_index(self.config.index)
@@ -120,11 +126,20 @@ class KVCacheIndexer:
             hashes = self.token_processor.prefix_hashes(tokens)
             if not hashes:
                 return {}
-            return self._fused_hash_score(model_name, hashes, pod_filter)
+            return self._filter_expired(
+                self._fused_hash_score(model_name, hashes, pod_filter)
+            )
         block_keys = self.token_processor.tokens_to_kv_block_keys(tokens, model_name)
         if not block_keys:
             return {}
         return self._lookup_and_score(block_keys, pod_filter)
+
+    def _filter_expired(self, scores: dict[str, int]) -> dict[str, int]:
+        """TTL guard: an expired pod must never win routing, even when its
+        swept-in-the-index state lags its expiry (sweeper cadence)."""
+        if self.fleet_health is None or not scores:
+            return scores
+        return self.fleet_health.filter_scores(scores)
 
     def _lookup_and_score(
         self, block_keys: list[Key], pod_filter: set[str]
@@ -132,6 +147,6 @@ class KVCacheIndexer:
         if self._fused_score is not None:
             scores = self._fused_score(block_keys, pod_filter)
             if scores is not None:
-                return scores
+                return self._filter_expired(scores)
         key_to_pods = self.kv_block_index.lookup(block_keys, pod_filter)
-        return self.scorer.score(block_keys, key_to_pods)
+        return self._filter_expired(self.scorer.score(block_keys, key_to_pods))

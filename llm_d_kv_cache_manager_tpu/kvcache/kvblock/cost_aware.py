@@ -110,3 +110,22 @@ class CostAwareMemoryIndex(Index):
                 self._total_cost -= self._costs.pop(key, 0)
             else:
                 self._recost(key)
+
+    def evict_pod(self, pod_identifier: str) -> int:
+        removed = 0
+        with self._lock:
+            for key in list(self._data):
+                pods = self._data[key]
+                stale = [e for e in pods if e.pod_identifier == pod_identifier]
+                if not stale:
+                    continue
+                pods.difference_update(stale)
+                removed += len(stale)
+                if not pods:
+                    del self._data[key]
+                    self._total_cost -= self._costs.pop(key, 0)
+                else:
+                    self._recost(key)
+        if removed:
+            log.debug("swept pod from index", pod=pod_identifier, entries=removed)
+        return removed

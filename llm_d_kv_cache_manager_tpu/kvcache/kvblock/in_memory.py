@@ -104,3 +104,29 @@ class InMemoryIndex(Index):
                     if len(current.cache) == 0:
                         self._data.remove(key)
                         log.trace("evicted key from index as no pods remain", key=str(key))
+
+    def evict_pod(self, pod_identifier: str) -> int:
+        removed = 0
+        # items() snapshots without promoting, so a sweep does not disturb
+        # key recency; keys added concurrently simply miss this pass (the
+        # pod is alive again, its entries belong).
+        for key, pod_cache in self._data.items():
+            with pod_cache.mu:
+                stale = [
+                    e
+                    for e in pod_cache.cache.keys()
+                    if e.pod_identifier == pod_identifier
+                ]
+                for e in stale:
+                    pod_cache.cache.remove(e)
+                removed += len(stale)
+                is_empty = len(pod_cache.cache) == 0
+            if is_empty:
+                current = self._data.get(key)
+                if current is not None:
+                    with current.mu:
+                        if len(current.cache) == 0:
+                            self._data.remove(key)
+        if removed:
+            log.debug("swept pod from index", pod=pod_identifier, entries=removed)
+        return removed

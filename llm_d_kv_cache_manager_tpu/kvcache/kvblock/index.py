@@ -41,6 +41,14 @@ class Index(ABC):
     def evict(self, key: Key, entries: Sequence[PodEntry]) -> None:
         """Remove pod entries for a key; drop the key once no pods remain."""
 
+    @abstractmethod
+    def evict_pod(self, pod_identifier: str) -> int:
+        """Fleet self-healing sweep: remove EVERY entry belonging to
+        ``pod_identifier`` (all keys, all tiers, all models), dropping keys
+        whose pod set empties. Used by the dead-pod sweeper after TTL
+        expiry and by ``IndexSnapshot`` replace-all-for-pod reconciliation.
+        Returns the number of entries removed."""
+
 
 @dataclass
 class InMemoryIndexConfig:

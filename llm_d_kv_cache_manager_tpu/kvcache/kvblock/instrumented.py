@@ -51,6 +51,13 @@ class InstrumentedIndex(Index):
         collector.evictions.inc(len(entries))
         collector.bump("evictions", len(entries))
 
+    def evict_pod(self, pod_identifier: str) -> int:
+        removed = self._inner.evict_pod(pod_identifier)
+        if removed:
+            collector.evictions.inc(removed)
+            collector.bump("evictions", removed)
+        return removed
+
     def __getattr__(self, name: str):
         # Fused scoring entry points (NativeMemoryIndex) pass through the
         # decorator with the same lookup metrics; __getattr__ only fires

@@ -143,6 +143,15 @@ class NativeMemoryIndex(Index):
         if pods:
             self._idx.evict(mid, key.chunk_hash, pods, tiers)
 
+    def evict_pod(self, pod_identifier: str) -> int:
+        pid = self._pod_id(pod_identifier, create=False)
+        if pid is None:  # never interned = never added: nothing to sweep
+            return 0
+        removed = int(self._idx.evict_pod(pid))
+        if removed:
+            log.debug("swept pod from index", pod=pod_identifier, entries=removed)
+        return removed
+
     def score_longest_prefix(
         self,
         keys: Sequence[Key],

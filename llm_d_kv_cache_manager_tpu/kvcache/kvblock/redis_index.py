@@ -19,7 +19,7 @@ from typing import Optional, Sequence
 
 from ...utils import get_logger
 from .index import Index, RedisIndexConfig
-from .keys import Key, PodEntry
+from .keys import DeviceTier, Key, PodEntry
 
 log = get_logger("kvcache.kvblock.redis")
 
@@ -87,3 +87,28 @@ class RedisIndex(Index):
         for entry in entries:
             pipe.hdel(str(key), str(entry))
         pipe.execute()
+
+    def evict_pod(self, pod_identifier: str) -> int:
+        """Dead-pod sweep: remove the pod's field (every tier) from every
+        block hash. Redis deletes a hash when its last field goes, so keys
+        whose pod set empties disappear — matching the in-memory backends.
+
+        One SCAN + one pipelined HDEL wave; the keyspace is the block
+        index itself (no other key families share the DB per the
+        deployment contract), so a full scan is the sweep's working set by
+        definition.
+        """
+        if hasattr(self._client, "scan_iter"):
+            keys = list(self._client.scan_iter())
+        else:  # minimal clients/fakes without SCAN support
+            keys = list(self._client.keys())
+        if not keys:
+            return 0
+        fields = [f"{pod_identifier}@{tier}" for tier in DeviceTier]
+        pipe = self._client.pipeline()
+        for key in keys:
+            pipe.hdel(key, *fields)
+        removed = sum(int(n) for n in pipe.execute())
+        if removed:
+            log.debug("swept pod from index", pod=pod_identifier, entries=removed)
+        return removed

@@ -4,8 +4,11 @@ from .events import (
     BlockStored,
     Event,
     EventBatch,
+    Heartbeat,
+    IndexSnapshot,
     decode_event_batch,
 )
+from .health import FleetHealth, FleetHealthConfig
 from .pool import KVEventsPool, KVEventsPoolConfig, Message, fnv1a_32
 from .zmq_subscriber import ZMQSubscriber, ZMQSubscriberConfig, parse_topic
 from .publisher import ZMQPublisher, ZMQPublisherConfig
@@ -16,7 +19,11 @@ __all__ = [
     "BlockStored",
     "Event",
     "EventBatch",
+    "Heartbeat",
+    "IndexSnapshot",
     "decode_event_batch",
+    "FleetHealth",
+    "FleetHealthConfig",
     "KVEventsPool",
     "KVEventsPoolConfig",
     "Message",

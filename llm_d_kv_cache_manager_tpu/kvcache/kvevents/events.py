@@ -9,6 +9,20 @@ msgpack *array-encoded* structs matching the serving engine's publisher —
 - ``BlockRemoved``: ``["BlockRemoved", block_hashes, medium?]``
 - ``AllBlocksCleared``: ``["AllBlocksCleared"]``
 
+Self-healing extensions (PR 3; only on the wire when a pod enables the
+heartbeat/resync knobs, so the default wire traffic is bit-identical and
+old subscribers simply skip the unknown tags):
+
+- ``Heartbeat``: ``["Heartbeat", dropped_batches?]`` — liveness beacon;
+  ``dropped_batches`` is the publisher's monotone count of batches dropped
+  after bounded send retries, so the indexer can detect loss even when no
+  later seq reveals the gap (e.g. the dropped batch was the last before
+  idle).
+- ``IndexSnapshot``: ``["IndexSnapshot", {medium: [block_hashes]}]`` — a
+  compact digest of every block the pod currently holds, per tier. The
+  ingestion pool applies it as replace-all-for-pod, the reconciliation
+  primitive behind sequence-gap repair.
+
 Decoding is positional and tolerant: trailing optional fields may be absent
 (the reference's "legacy" variants, ``events.go:113-153``) and unknown extra
 fields are ignored — this subsumes the reference's arity-sniffing legacy
@@ -25,6 +39,8 @@ import msgpack
 BLOCK_STORED_TAG = "BlockStored"
 BLOCK_REMOVED_TAG = "BlockRemoved"
 ALL_BLOCKS_CLEARED_TAG = "AllBlocksCleared"
+HEARTBEAT_TAG = "Heartbeat"
+INDEX_SNAPSHOT_TAG = "IndexSnapshot"
 
 
 @dataclass
@@ -63,7 +79,27 @@ class AllBlocksCleared:
         return [ALL_BLOCKS_CLEARED_TAG]
 
 
-Event = Union[BlockStored, BlockRemoved, AllBlocksCleared]
+@dataclass
+class Heartbeat:
+    #: publisher's monotone dropped-batch count (bounded-retry overflow)
+    dropped_batches: int = 0
+
+    def to_tagged_union(self) -> list[Any]:
+        return [HEARTBEAT_TAG, self.dropped_batches]
+
+
+@dataclass
+class IndexSnapshot:
+    """Digest of every block a pod currently holds, keyed by medium string
+    (``tpu_hbm``/``host_dram``). Applied as replace-all-for-pod."""
+
+    blocks_by_medium: dict[str, list[int]] = field(default_factory=dict)
+
+    def to_tagged_union(self) -> list[Any]:
+        return [INDEX_SNAPSHOT_TAG, self.blocks_by_medium]
+
+
+Event = Union[BlockStored, BlockRemoved, AllBlocksCleared, Heartbeat, IndexSnapshot]
 
 
 @dataclass
@@ -126,6 +162,23 @@ def _decode_event(raw) -> Optional[Event]:
         return BlockRemoved(block_hashes=[int(h) for h in hashes], medium=medium)
     if tag == ALL_BLOCKS_CLEARED_TAG:
         return AllBlocksCleared()
+    if tag == HEARTBEAT_TAG:
+        dropped = _get(fields, 0, 0)
+        if not isinstance(dropped, int) or isinstance(dropped, bool):
+            dropped = 0
+        return Heartbeat(dropped_batches=dropped)
+    if tag == INDEX_SNAPSHOT_TAG:
+        raw_digest = _get(fields, 0)
+        if not isinstance(raw_digest, dict):
+            return None
+        digest: dict[str, list[int]] = {}
+        for medium, hashes in raw_digest.items():
+            if isinstance(medium, bytes):
+                medium = medium.decode("utf-8", "replace")
+            if not isinstance(medium, str) or not isinstance(hashes, (list, tuple)):
+                return None
+            digest[medium] = [int(h) for h in hashes]
+        return IndexSnapshot(blocks_by_medium=digest)
     return None  # unknown tag
 
 

@@ -1,0 +1,281 @@
+"""Fleet health: sequence-gap detection, liveness tracking, dead-pod sweep.
+
+The indexer's view of the fleet is event-sourced and therefore only as
+truthful as the event stream. Three failure modes rot it:
+
+1. **Dropped events** — the publisher's bounded send retry drops batches on
+   overflow; a lost ``BlockRemoved`` leaves phantom locality, a lost
+   ``BlockStored`` hides real warmth. Every message carries a per-publisher
+   ``seq``; this module tracks last-seen seq per (pod, model) and flags a
+   *gap* whenever the stream skips forward — the pod's view is then
+   **suspect** until an ``IndexSnapshot`` resync replaces it wholesale.
+2. **Crashed pods** — a dead pod never emits its evictions, so its
+   ``BlockStored`` entries would live in the index forever. Pods publish
+   ``Heartbeat`` events; after ``pod_ttl_s`` of silence the sweeper evicts
+   the pod from the index (``Index.evict_pod``) and the scorer filter stops
+   returning it even before the sweep lands.
+3. **Silent publisher drops** — a dropped batch with no later traffic never
+   produces a detectable seq gap. Heartbeats carry the publisher's monotone
+   ``dropped_batches`` count, so loss is detected even across idle periods.
+
+All tracking is observation-only until configured: ``pod_ttl_s=0`` (the
+default) disables expiry/sweeping entirely, and a pool without an attached
+``FleetHealth`` behaves bit-identically to previous rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ...utils import get_logger
+from ..kvblock import Index
+from ..metrics import collector
+
+log = get_logger("kvcache.kvevents.health")
+
+
+@dataclass
+class FleetHealthConfig:
+    #: seconds of silence after which a pod is expired and swept from the
+    #: index. 0 (default) disables liveness expiry — observation only.
+    pod_ttl_s: float = 0.0
+    #: sweeper cadence; clamped to pod_ttl_s/4 when a TTL is set so expiry
+    #: is detected well within one TTL.
+    sweep_interval_s: float = 1.0
+
+
+@dataclass
+class _PodState:
+    #: wall-clock time of the last message seen from this pod (any event)
+    last_seen: float = 0.0
+    #: last-seen publisher seq per model topic
+    last_seq: dict[str, int] = field(default_factory=dict)
+    #: gap (or reported drop) observed and not yet repaired by a resync
+    suspect: bool = False
+    #: swept from the index by the TTL sweeper; clears on any new message
+    swept: bool = False
+    #: last publisher-reported dropped_batches count (from Heartbeat)
+    reported_drops: int = 0
+
+
+class FleetHealth:
+    """Per-pod liveness + stream-integrity tracker shared by the ingestion
+    pool (writer), the sweeper thread, and the scorer read path (filter)."""
+
+    def __init__(
+        self,
+        config: Optional[FleetHealthConfig] = None,
+        *,
+        clock=time.monotonic,
+    ):
+        self.config = config or FleetHealthConfig()
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._pods: dict[str, _PodState] = {}
+        # Monotone counters (mirrored into the metrics collector).
+        self.gaps_detected = 0
+        self.resyncs_applied = 0
+        self.pods_swept = 0
+        self.heartbeats_seen = 0
+        self.publisher_drops_reported = 0
+        self._sweep_thread: Optional[threading.Thread] = None
+        self._sweep_stop = threading.Event()
+
+    # -- ingestion-side observations (called from pool workers) -------------
+    def observe_message(self, pod: str, model: str, seq: int) -> bool:
+        """Record a message arrival; returns True when a seq gap was
+        detected (caller marks the pod's view suspect → resync repairs)."""
+        now = self._clock()
+        gap = False
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.last_seen = now
+            st.swept = False  # pod is alive again — revive it
+            last = st.last_seq.get(model)
+            if last is not None and seq > last + 1:
+                gap = True
+                st.suspect = True
+                self.gaps_detected += 1
+            elif last is not None and seq < last and seq > 0:
+                # Regression: a publisher restart whose seq-0 message was
+                # itself lost (the loss case this module exists for), or
+                # out-of-order redelivery. Flag ONE gap and REBASE to the
+                # new stream — keeping the old high-water mark would flag
+                # every subsequent message of a restarted stream as a
+                # fresh gap until it passed the old count (a WARN storm
+                # that re-marks the pod suspect after every resync).
+                # Rebasing costs at most one extra catch-up gap if the
+                # regression was a genuine straggler; both paths end in
+                # the same repair (suspect → resync).
+                gap = True
+                st.suspect = True
+                self.gaps_detected += 1
+            st.last_seq[model] = seq
+        if gap:
+            collector.bump("fleet_gaps")
+            collector.fleet_gaps.inc()
+            log.warning(
+                "event seq gap detected; pod view suspect until resync",
+                pod=pod, model=model, seq=seq,
+            )
+        return gap
+
+    def observe_heartbeat(self, pod: str, dropped_batches: int) -> None:
+        """A heartbeat proves liveness and reports the publisher's drop
+        count; an increase means batches were lost even if no later seq
+        ever reveals the gap."""
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.last_seen = self._clock()
+            st.swept = False
+            self.heartbeats_seen += 1
+            if dropped_batches < st.reported_drops:
+                # Publisher restart: its drop counter restarted too. Rebase
+                # the baseline or the new publisher's first drops (up to
+                # the old total) would be silently masked. Drops that
+                # happened before this first post-restart heartbeat still
+                # surface as seq gaps via observe_message.
+                st.reported_drops = dropped_batches
+            new_drops = dropped_batches - st.reported_drops
+            if new_drops:
+                st.reported_drops = dropped_batches
+                st.suspect = True
+                self.publisher_drops_reported += new_drops
+        if new_drops:
+            collector.bump("fleet_publisher_drops", new_drops)
+            collector.fleet_publisher_drops.inc(new_drops)
+            log.warning(
+                "publisher reported dropped batches; pod view suspect",
+                pod=pod, new_drops=new_drops, total=dropped_batches,
+            )
+
+    def observe_resync(self, pod: str) -> None:
+        """An ``IndexSnapshot`` replaced the pod's view — clear suspicion."""
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.last_seen = self._clock()
+            st.suspect = False
+            st.swept = False
+            self.resyncs_applied += 1
+        collector.bump("fleet_resyncs")
+        collector.fleet_resyncs.inc()
+
+    # -- read-side queries ---------------------------------------------------
+    def is_expired(self, pod: str) -> bool:
+        """True when the pod passed its TTL (or was swept) and has not been
+        heard from since. Unknown pods are NOT expired: entries may predate
+        this monitor's attachment, and expiring them would break the
+        observation-only default."""
+        ttl = self.config.pod_ttl_s
+        with self._mu:
+            st = self._pods.get(pod)
+            if st is None:
+                return False
+            if st.swept:
+                return True
+            if ttl <= 0:
+                return False
+            return (self._clock() - st.last_seen) > ttl
+
+    def is_suspect(self, pod: str) -> bool:
+        with self._mu:
+            st = self._pods.get(pod)
+            return bool(st and st.suspect)
+
+    def filter_scores(self, scores: dict[str, int]) -> dict[str, int]:
+        """Drop expired pods from a score map — the guarantee that routing
+        never targets a pod past its TTL, even before the sweeper lands."""
+        if not scores:
+            return scores
+        out = {p: s for p, s in scores.items() if not self.is_expired(p)}
+        return out if len(out) != len(scores) else scores
+
+    def snapshot(self) -> dict:
+        """Counters + per-pod state for ``/stats``."""
+        with self._mu:
+            pods = {
+                pod: {
+                    "suspect": st.suspect,
+                    "swept": st.swept,
+                    "age_s": round(self._clock() - st.last_seen, 3),
+                }
+                for pod, st in self._pods.items()
+            }
+        return {
+            "pod_ttl_s": self.config.pod_ttl_s,
+            "gaps_detected": self.gaps_detected,
+            "resyncs_applied": self.resyncs_applied,
+            "pods_swept": self.pods_swept,
+            "heartbeats_seen": self.heartbeats_seen,
+            "publisher_drops_reported": self.publisher_drops_reported,
+            "pods": pods,
+        }
+
+    # -- dead-pod sweeper ----------------------------------------------------
+    def sweep(self, index: Index) -> list[str]:
+        """Evict every TTL-expired pod from the index (one shot). Returns
+        the pods swept. Safe to call concurrently with ingestion: a revived
+        pod's later events re-add its entries, same eventual-consistency
+        contract as normal eviction."""
+        ttl = self.config.pod_ttl_s
+        if ttl <= 0:
+            return []
+        now = self._clock()
+        with self._mu:
+            stale = [
+                pod
+                for pod, st in self._pods.items()
+                if not st.swept and (now - st.last_seen) > ttl
+            ]
+            for pod in stale:
+                self._pods[pod].swept = True
+        swept = []
+        for pod in stale:
+            try:
+                index.evict_pod(pod)
+            except Exception:
+                # Un-mark so the next sweep retries; routing stays safe
+                # meanwhile because is_expired() is true via the TTL check
+                # regardless of the swept flag.
+                log.exception("dead-pod sweep failed", pod=pod)
+                with self._mu:
+                    st = self._pods.get(pod)
+                    if st is not None:
+                        st.swept = False
+                continue
+            swept.append(pod)
+            with self._mu:
+                self.pods_swept += 1
+            collector.bump("fleet_pods_swept")
+            collector.fleet_pods_swept.inc()
+            log.warning("swept dead pod from index", pod=pod, ttl_s=ttl)
+        return swept
+
+    def start_sweeper(self, index: Index) -> None:
+        """Background TTL sweeper (idempotent; no-op when pod_ttl_s == 0)."""
+        if self.config.pod_ttl_s <= 0:
+            return
+        if self._sweep_thread is not None and self._sweep_thread.is_alive():
+            return
+        interval = min(
+            self.config.sweep_interval_s, max(self.config.pod_ttl_s / 4, 0.01)
+        )
+        self._sweep_stop.clear()
+
+        def run():
+            while not self._sweep_stop.wait(interval):
+                self.sweep(index)
+
+        self._sweep_thread = threading.Thread(
+            target=run, name="fleet-health-sweeper", daemon=True
+        )
+        self._sweep_thread.start()
+
+    def stop_sweeper(self) -> None:
+        self._sweep_stop.set()
+        if self._sweep_thread is not None:
+            self._sweep_thread.join(timeout=5)
+            self._sweep_thread = None

@@ -24,8 +24,15 @@ from .events import (
     AllBlocksCleared,
     BlockRemoved,
     BlockStored,
+    Heartbeat,
+    IndexSnapshot,
     decode_event_batch,
 )
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # avoids a runtime import cycle with health.py
+    from .health import FleetHealth
 
 log = get_logger("kvcache.kvevents.pool")
 
@@ -60,18 +67,35 @@ class KVEventsPoolConfig:
 
 
 class KVEventsPool:
-    """Sharded ordered worker pool applying KV events to the index."""
+    """Sharded ordered worker pool applying KV events to the index.
 
-    def __init__(self, index: Index, config: Optional[KVEventsPoolConfig] = None):
+    ``health`` (optional, a ``FleetHealth``) receives per-message stream
+    observations — last-seen seq per (pod, model) for gap detection,
+    heartbeats, resync acknowledgements. ``None`` (default) keeps the
+    legacy behavior bit-identical.
+    """
+
+    def __init__(
+        self,
+        index: Index,
+        config: Optional[KVEventsPoolConfig] = None,
+        health: Optional["FleetHealth"] = None,
+    ):
         self.config = config or KVEventsPoolConfig()
         if self.config.concurrency < 1:
             raise ValueError("concurrency must be >= 1")
         self.index = index
+        self.health = health
+        #: tasks rejected because the pool was already shut down — after the
+        #: poison pill a task would sit unprocessed forever, which is worse
+        #: than an honest drop (the index self-heals via resync anyway).
+        self.rejected_after_shutdown = 0
         self._queues: list["queue.Queue[Optional[Message]]"] = [
             queue.Queue() for _ in range(self.config.concurrency)
         ]
         self._threads: list[threading.Thread] = []
         self._running = False
+        self._started = False
         self._mu = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
@@ -80,6 +104,7 @@ class KVEventsPool:
             if self._running:
                 return
             self._running = True
+            self._started = True
             for i in range(self.config.concurrency):
                 t = threading.Thread(
                     target=self._worker, args=(i,), name=f"kvevents-worker-{i}", daemon=True
@@ -88,6 +113,9 @@ class KVEventsPool:
                 self._threads.append(t)
 
     def shutdown(self) -> None:
+        """Idempotent. Drain ordering: the poison pill is enqueued BEHIND
+        any already-queued events, so every event accepted before shutdown
+        is applied to the index before the workers join."""
         with self._mu:
             if not self._running:
                 return
@@ -111,9 +139,18 @@ class KVEventsPool:
 
     # -- ingestion ----------------------------------------------------------
     def add_task(self, msg: Message) -> None:
-        """Shard by pod id so per-pod ordering holds."""
+        """Shard by pod id so per-pod ordering holds. Tasks offered after
+        shutdown are rejected (counted), never silently parked behind the
+        poison pill — the check and the enqueue share the pool lock, so a
+        racing shutdown cannot slip its pill under an admitted task."""
         shard = fnv1a_32(msg.pod_identifier.encode("utf-8")) % self.config.concurrency
-        self._queues[shard].put(msg)
+        with self._mu:
+            if self._started and not self._running:
+                self.rejected_after_shutdown += 1
+            else:
+                self._queues[shard].put(msg)
+                return
+        log.warning("event after pool shutdown; dropping", pod=msg.pod_identifier)
 
     def _worker(self, shard: int) -> None:
         q = self._queues[shard]
@@ -137,6 +174,12 @@ class KVEventsPool:
             log.debug("failed to unmarshal event batch, dropping message", topic=msg.topic)
             return
 
+        # Stream-integrity observation BEFORE applying: per-pod ordering is
+        # guaranteed by sharding, so last-seen seq per (pod, model) is
+        # exact; a skip marks the pod's view suspect until a resync.
+        if self.health is not None:
+            self.health.observe_message(msg.pod_identifier, msg.model_name, msg.seq)
+
         for ev in batch.events:
             if isinstance(ev, BlockStored):
                 keys = [Key(msg.model_name, h) for h in ev.block_hashes]
@@ -159,7 +202,52 @@ class KVEventsPool:
                         self.index.evict(Key(msg.model_name, h), entries)
                     except Exception:
                         log.exception("failed to evict from index", pod=msg.pod_identifier)
+            elif isinstance(ev, Heartbeat):
+                if self.health is not None:
+                    self.health.observe_heartbeat(
+                        msg.pod_identifier, ev.dropped_batches
+                    )
+            elif isinstance(ev, IndexSnapshot):
+                self._apply_snapshot(msg, ev)
             elif isinstance(ev, AllBlocksCleared):
                 # No-op, as in the reference (pool.go:300-301): the event
                 # carries no hash list, and the index ages entries out.
                 continue
+
+    def _apply_snapshot(self, msg: Message, ev: IndexSnapshot) -> None:
+        """Replace-all-for-pod reconciliation: the digest IS the pod's KV
+        cache, so first drop every entry the index holds for the pod, then
+        add exactly the digest. Runs on the pod's own shard worker, so it
+        is ordered against the pod's normal event stream.
+
+        Contract: a pod identifier serves ONE model (the in-tree PodServer
+        invariant — one engine, one topic ``kv@<pod>@<model>``; the digest
+        covers that engine's whole cache). ``evict_pod`` sweeps all models,
+        so a pod identity shared by publishers of different models would
+        have its other models' entries wiped here — give each engine its
+        own pod identifier instead."""
+        try:
+            self.index.evict_pod(msg.pod_identifier)
+        except Exception:
+            log.exception("resync: evict_pod failed", pod=msg.pod_identifier)
+            return
+        for medium, hashes in ev.blocks_by_medium.items():
+            if not hashes:
+                continue
+            keys = [Key(msg.model_name, h) for h in hashes]
+            entries = [PodEntry(msg.pod_identifier, tier_for_medium(medium))]
+            try:
+                self.index.add(keys, entries)
+            except Exception:
+                log.exception(
+                    "resync: failed to apply snapshot tier",
+                    pod=msg.pod_identifier,
+                    medium=medium,
+                )
+        if self.health is not None:
+            self.health.observe_resync(msg.pod_identifier)
+        log.info(
+            "applied index snapshot (replace-all-for-pod)",
+            pod=msg.pod_identifier,
+            blocks={m: len(h) for m, h in ev.blocks_by_medium.items()},
+        )

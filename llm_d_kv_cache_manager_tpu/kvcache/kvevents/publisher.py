@@ -69,6 +69,10 @@ class ZMQPublisher:
                 log.warning("publish after close; dropping batch")
                 self.dropped_batches += 1
                 return -1
+            # The seq is consumed HERE, before any send attempt: a dropped
+            # batch therefore leaves a hole in the stream and the next
+            # successful publish exposes it — subscribers detect the gap
+            # and trigger resync instead of silently desyncing.
             seq = self._seq
             self._seq += 1
             frames = [self.topic.encode("utf-8"), struct.pack(">Q", seq), payload]
@@ -79,13 +83,17 @@ class ZMQPublisher:
                 except zmq.ZMQError as e:
                     if attempt + 1 == _SEND_ATTEMPTS:
                         # Give up: the engine loop must keep serving; the
-                        # index self-heals via LRU staleness.
+                        # dropped-batch counter rides on heartbeats and the
+                        # skipped seq flags the gap to subscribers.
                         self.dropped_batches += 1
-                        log.error(
-                            "dropping event batch after retries",
+                        log.warning(
+                            "dropping event batch after bounded retries",
+                            pod=self.config.pod_identifier,
+                            model=self.config.model_name,
                             error=repr(e),
                             attempts=_SEND_ATTEMPTS,
                             seq=seq,
+                            dropped_total=self.dropped_batches,
                         )
                         return -1
                     time.sleep(_SEND_BACKOFF_S * (2**attempt))

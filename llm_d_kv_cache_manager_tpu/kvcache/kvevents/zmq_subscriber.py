@@ -45,11 +45,19 @@ def parse_topic(topic: str) -> Optional[tuple[str, str]]:
 
 
 class ZMQSubscriber:
-    """Feeds a KVEventsPool from a bound SUB socket."""
+    """Feeds a KVEventsPool from a bound SUB socket.
+
+    Frame hardening: the SUB socket receives raw network input, so every
+    malformed shape — wrong frame count, short/overlong seq frame,
+    undecodable or unparseable topic — is counted in ``malformed_dropped``
+    and dropped; nothing a peer sends can kill the receive loop.
+    """
 
     def __init__(self, pool: KVEventsPool, config: Optional[ZMQSubscriberConfig] = None):
         self.pool = pool
         self.config = config or ZMQSubscriberConfig()
+        #: drop counters by malformed shape (surfaced in /stats)
+        self.malformed_dropped = {"frames": 0, "seq": 0, "topic": 0}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -101,23 +109,41 @@ class ZMQSubscriber:
                 if not dict(poller.poll(_POLL_TIMEOUT_MS)):
                     continue
                 frames = sock.recv_multipart()
-                msg = self._parse_frames(frames)
+                try:
+                    msg = self._parse_frames(frames)
+                except Exception:
+                    # Belt-and-braces: a parse bug must not tear down the
+                    # receive loop into a reconnect storm.
+                    log.exception("frame parse failed; dropping message")
+                    continue
                 if msg is not None:
                     self.pool.add_task(msg)
         finally:
             sock.close(linger=0)
 
-    @staticmethod
-    def _parse_frames(frames: list[bytes]) -> Optional[Message]:
+    def _parse_frames(self, frames: list[bytes]) -> Optional[Message]:
         if len(frames) != 3:
-            log.debug("dropping malformed zmq message", n_frames=len(frames))
+            self.malformed_dropped["frames"] += 1
+            log.warning("dropping malformed zmq message", n_frames=len(frames))
             return None
         topic_raw, seq_raw, payload = frames
-        topic = topic_raw.decode("utf-8", "replace")
+        if len(seq_raw) != 8:
+            # A wrong-width seq frame means the peer speaks a different
+            # protocol; guessing seq=0 would poison gap detection.
+            self.malformed_dropped["seq"] += 1
+            log.warning("dropping message with bad seq frame", n_bytes=len(seq_raw))
+            return None
+        try:
+            topic = topic_raw.decode("utf-8")
+        except UnicodeDecodeError:
+            self.malformed_dropped["topic"] += 1
+            log.warning("dropping message with undecodable topic")
+            return None
         parsed = parse_topic(topic)
         if parsed is None:
-            log.debug("dropping message with unparseable topic", topic=topic)
+            self.malformed_dropped["topic"] += 1
+            log.warning("dropping message with unparseable topic", topic=topic)
             return None
         pod, model = parsed
-        seq = struct.unpack(">Q", seq_raw)[0] if len(seq_raw) == 8 else 0
+        seq = struct.unpack(">Q", seq_raw)[0]
         return Message(topic=topic, pod_identifier=pod, model_name=model, payload=payload, seq=seq)

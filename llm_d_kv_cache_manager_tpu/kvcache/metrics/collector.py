@@ -50,6 +50,14 @@ evictions = _NullMetric()
 lookup_requests = _NullMetric()
 lookup_hits = _NullMetric()
 lookup_latency = _NullMetric()
+# Fleet self-healing (PR 3): seq gaps, snapshot resyncs, dead-pod sweeps,
+# publisher-reported drops, transfer circuit-breaker transitions.
+fleet_gaps = _NullMetric()
+fleet_resyncs = _NullMetric()
+fleet_pods_swept = _NullMetric()
+fleet_publisher_drops = _NullMetric()
+breaker_opens = _NullMetric()
+breaker_closes = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -57,6 +65,12 @@ _shadow = {
     "evictions": 0,
     "lookup_requests": 0,
     "lookup_hits": 0,
+    "fleet_gaps": 0,
+    "fleet_resyncs": 0,
+    "fleet_pods_swept": 0,
+    "fleet_publisher_drops": 0,
+    "breaker_opens": 0,
+    "breaker_closes": 0,
 }
 _shadow_lock = threading.Lock()
 
@@ -74,6 +88,8 @@ def snapshot() -> dict:
 def register(registry=None) -> None:
     """Idempotently create and register the collectors."""
     global _registered, admissions, evictions, lookup_requests, lookup_hits, lookup_latency
+    global fleet_gaps, fleet_resyncs, fleet_pods_swept, fleet_publisher_drops
+    global breaker_opens, breaker_closes
     with _lock:
         if _registered:
             return
@@ -106,6 +122,36 @@ def register(registry=None) -> None:
             "Latency of index lookups in seconds",
             registry=registry,
             buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+        )
+        fleet_gaps = _prom.Counter(
+            "kvcache_fleet_event_gaps_total",
+            "Sequence gaps detected in pod event streams",
+            registry=registry,
+        )
+        fleet_resyncs = _prom.Counter(
+            "kvcache_fleet_resyncs_total",
+            "IndexSnapshot resyncs applied (replace-all-for-pod)",
+            registry=registry,
+        )
+        fleet_pods_swept = _prom.Counter(
+            "kvcache_fleet_pods_swept_total",
+            "Pods swept from the index after TTL expiry",
+            registry=registry,
+        )
+        fleet_publisher_drops = _prom.Counter(
+            "kvcache_fleet_publisher_drops_total",
+            "Event batches publishers reported dropping (via heartbeats)",
+            registry=registry,
+        )
+        breaker_opens = _prom.Counter(
+            "kvcache_transfer_breaker_opens_total",
+            "Transfer circuit-breaker open transitions",
+            registry=registry,
+        )
+        breaker_closes = _prom.Counter(
+            "kvcache_transfer_breaker_closes_total",
+            "Transfer circuit-breaker close transitions (half-open probe ok)",
+            registry=registry,
         )
         _registered = True
 

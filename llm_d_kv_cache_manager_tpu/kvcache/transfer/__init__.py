@@ -17,7 +17,12 @@ The engine-side export/import endpoints live in ``server/engine.py`` and
 pod (``TRANSFER_ENDPOINT``; off by default = legacy behavior).
 """
 
-from .client import KVTransferClient, TransferClientConfig, TransferError
+from .client import (
+    CircuitBreaker,
+    KVTransferClient,
+    TransferClientConfig,
+    TransferError,
+)
 from .cost_model import TransferCostModel, TransferCostModelConfig
 from .protocol import (
     BlockPayload,
@@ -30,6 +35,7 @@ from .service import KVTransferService, TransferServiceConfig
 
 __all__ = [
     "BlockPayload",
+    "CircuitBreaker",
     "KVTransferClient",
     "KVTransferService",
     "TransferClientConfig",

@@ -9,7 +9,13 @@ prefix cold", never wedge or crash the puller. So:
 - after a timeout the socket is torn down and rebuilt, so a late straggler
   reply can never be mis-matched to the next request;
 - successful fetches report ``(wire_bytes, seconds)`` to ``on_sample`` —
-  the measured-link feed of the router's transfer-vs-recompute cost model.
+  the measured-link feed of the router's transfer-vs-recompute cost model;
+- an optional per-peer **circuit breaker** (``breaker_failures > 0``) trips
+  after consecutive failures, so a dead peer costs one timeout, not one
+  timeout per request: while OPEN every fetch fails instantly (the caller's
+  cold-prefill fallback runs with zero added latency) until an exponential
+  backoff expires, then exactly one HALF_OPEN probe decides between CLOSED
+  (recovered) and OPEN with doubled backoff.
 """
 
 from __future__ import annotations
@@ -20,6 +26,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 from ...utils import get_logger
+from ..metrics import collector
 from .protocol import BlockPayload, decode_response, encode_request
 
 log = get_logger("kvcache.transfer.client")
@@ -33,6 +40,123 @@ class TransferError(RuntimeError):
 class TransferClientConfig:
     endpoint: str = "tcp://localhost:5558"
     timeout_s: float = 10.0
+    #: consecutive failures that trip the per-peer circuit breaker;
+    #: 0 (default) disables the breaker — bit-identical legacy behavior.
+    breaker_failures: int = 0
+    #: first OPEN interval; doubles on each failed half-open probe.
+    breaker_backoff_s: float = 1.0
+    #: cap on the doubled backoff.
+    breaker_backoff_max_s: float = 30.0
+
+
+class CircuitBreaker:
+    """Per-peer failure breaker: CLOSED → OPEN after ``failure_threshold``
+    consecutive failures → HALF_OPEN (single probe) after backoff →
+    CLOSED on probe success / OPEN (backoff doubled, capped) on failure.
+
+    Thread-safe; ``clock`` is injectable for deterministic tests.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        failure_threshold: int,
+        backoff_s: float = 1.0,
+        backoff_max_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.base_backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0  # consecutive
+        self._backoff_s = backoff_s
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.opens = 0
+        self.closes = 0
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            if self._state == self.OPEN and (
+                self._clock() - self._opened_at >= self._backoff_s
+            ):
+                return self.HALF_OPEN  # next allow() admits the probe
+            return self._state
+
+    def allow(self) -> bool:
+        """True when a request may proceed. While OPEN within backoff:
+        False. After backoff: admits exactly ONE half-open probe; further
+        calls are rejected until that probe reports."""
+        with self._mu:
+            if self._state == self.CLOSED:
+                return True
+            now = self._clock()
+            if self._state == self.OPEN:
+                if now - self._opened_at < self._backoff_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._probe_inflight = True
+                return True
+            # HALF_OPEN: one probe at a time
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._mu:
+            recovered = self._state != self.CLOSED
+            self._state = self.CLOSED
+            self._failures = 0
+            self._backoff_s = self.base_backoff_s
+            self._probe_inflight = False
+            if recovered:
+                self.closes += 1
+        if recovered:
+            collector.bump("breaker_closes")
+            collector.breaker_closes.inc()
+
+    def record_failure(self) -> None:
+        opened = False
+        with self._mu:
+            self._failures += 1
+            if self._state == self.HALF_OPEN:
+                # failed probe: reopen with doubled backoff
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._backoff_s = min(self._backoff_s * 2, self.backoff_max_s)
+                self._probe_inflight = False
+                self.opens += 1
+                opened = True
+            elif (
+                self._state == self.CLOSED
+                and self._failures >= self.failure_threshold
+            ):
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._backoff_s = self.base_backoff_s
+                self.opens += 1
+                opened = True
+        if opened:
+            collector.bump("breaker_opens")
+            collector.breaker_opens.inc()
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._failures,
+                "backoff_s": self._backoff_s,
+                "opens": self.opens,
+                "closes": self.closes,
+            }
 
 
 class KVTransferClient:
@@ -40,9 +164,18 @@ class KVTransferClient:
         self,
         config: TransferClientConfig,
         on_sample: Optional[Callable[[int, float], None]] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         self.config = config
         self.on_sample = on_sample
+        self.breaker = breaker
+        if self.breaker is None and config.breaker_failures > 0:
+            self.breaker = CircuitBreaker(
+                config.breaker_failures,
+                config.breaker_backoff_s,
+                config.breaker_backoff_max_s,
+            )
+        self.breaker_skips = 0  # fetches rejected instantly by an open breaker
         self._mu = threading.Lock()
         self._sock = None
         self._closed = False
@@ -69,11 +202,37 @@ class KVTransferClient:
     ) -> tuple[list[BlockPayload], bool]:
         """Fetch the longest resident prefix of ``block_hashes`` from the
         peer. Returns ``(blocks, complete)``; raises ``TransferError`` on
-        timeout/service failure (callers fall back to cold prefill)."""
-        import zmq
-
+        timeout/service failure (callers fall back to cold prefill). With
+        a tripped breaker the error is raised immediately — no socket I/O,
+        no timeout wait."""
         if not block_hashes:
             return [], True
+        if self.breaker is not None and not self.breaker.allow():
+            self.breaker_skips += 1
+            raise TransferError(
+                f"circuit open for {self.config.endpoint} "
+                f"(skipping fetch; cold prefill)"
+            )
+        try:
+            blocks, complete = self._fetch_once(model_name, block_hashes, max_blocks)
+        except Exception:
+            # Any failure settles the breaker (a stuck half-open probe
+            # would otherwise reject every later fetch forever).
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        if self.breaker is not None:
+            self.breaker.record_success()
+        return blocks, complete
+
+    def _fetch_once(
+        self,
+        model_name: str,
+        block_hashes: Sequence[int],
+        max_blocks: Optional[int],
+    ) -> tuple[list[BlockPayload], bool]:
+        import zmq
+
         with self._mu:
             if self._closed:
                 raise TransferError("client closed")

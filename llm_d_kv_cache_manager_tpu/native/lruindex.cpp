@@ -219,6 +219,28 @@ class LruIndex {
         return scored_pods.size();
     }
 
+    // Fleet self-healing sweep: remove every entry of `pod` (all models,
+    // all tiers), deleting keys whose pod set empties. Walks the LRU list
+    // once without touching recency. Returns entries removed.
+    uint64_t evict_pod(uint32_t pod) {
+        std::lock_guard<std::mutex> g(mu_);
+        uint64_t removed = 0;
+        Node* n = head_;
+        while (n) {
+            Node* next = n->next;
+            auto& v = n->pods;
+            for (size_t p = v.size(); p > 0; --p) {
+                if (v[p - 1].pod == pod) {
+                    v.erase(v.begin() + long(p - 1));
+                    ++removed;
+                }
+            }
+            if (v.empty()) remove_node(n);
+            n = next;
+        }
+        return removed;
+    }
+
     uint64_t size() {
         std::lock_guard<std::mutex> g(mu_);
         return map_.size();
@@ -326,6 +348,10 @@ uint64_t lruidx_score(void* h, uint32_t model, const uint64_t* hashes,
     return static_cast<LruIndex*>(h)->score(model, hashes, n_keys, filter,
                                             n_filter, out_pods, out_scores,
                                             out_hits);
+}
+
+uint64_t lruidx_evict_pod(void* h, uint32_t pod) {
+    return static_cast<LruIndex*>(h)->evict_pod(pod);
 }
 
 uint64_t lruidx_size(void* h) { return static_cast<LruIndex*>(h)->size(); }

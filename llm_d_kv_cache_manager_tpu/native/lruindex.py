@@ -56,6 +56,11 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.lruidx_size.restype = ctypes.c_uint64
         lib.lruidx_size.argtypes = [ctypes.c_void_p]
+        try:  # PR-3 symbol: absent in pre-self-healing builds of the .so
+            lib.lruidx_evict_pod.restype = ctypes.c_uint64
+            lib.lruidx_evict_pod.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        except AttributeError:
+            pass
         _lib = lib
     except OSError:
         _lib = None
@@ -145,6 +150,16 @@ class NativeLru:
             n_filter, out_pods, out_scores, out_hits,
         )
         return [(out_pods[i], out_scores[i]) for i in range(n)], int(out_hits[0])
+
+    def evict_pod(self, pod_id: int) -> int:
+        """Remove every entry of ``pod_id``; returns entries removed. Raises
+        when the loaded library predates the symbol (rebuild required)."""
+        if not hasattr(self._lib, "lruidx_evict_pod"):
+            raise RuntimeError(
+                "liblruindex.so predates lruidx_evict_pod — rebuild with "
+                "`python -m llm_d_kv_cache_manager_tpu.native.build`"
+            )
+        return int(self._lib.lruidx_evict_pod(self._h, pod_id))
 
     def size(self) -> int:
         return self._lib.lruidx_size(self._h)

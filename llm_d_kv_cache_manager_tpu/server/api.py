@@ -31,6 +31,8 @@ from aiohttp import web
 from ..kvcache import KVCacheIndexer, KVCacheIndexerConfig
 from ..kvcache.kvblock import TokenProcessorConfig
 from ..kvcache.kvevents import (
+    FleetHealth,
+    FleetHealthConfig,
     KVEventsPool,
     KVEventsPoolConfig,
     ZMQSubscriber,
@@ -57,6 +59,10 @@ class ServiceConfig:
     # Use the C++ index backend when its library is built (strictly faster,
     # same conformance-tested semantics); NATIVE_INDEX=0 forces pure Python.
     native_index: bool = True
+    #: fleet self-healing: seconds of pod silence before its entries are
+    #: swept from the index and it stops being scored. 0 (default) = off —
+    #: observation-only health tracking, legacy routing behavior.
+    pod_ttl_s: float = 0.0
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -72,6 +78,7 @@ class ServiceConfig:
             enable_metrics=env.get("ENABLE_METRICS", "true").lower() != "false",
             metrics_logging_interval=float(env.get("METRICS_LOGGING_INTERVAL", "0")),
             native_index=env.get("NATIVE_INDEX", "1").lower() not in ("0", "false"),
+            pod_ttl_s=float(env.get("POD_TTL_S", "0")),
         )
 
 
@@ -111,6 +118,9 @@ class ScoringService:
 
         from ..kvcache.kvblock import IndexConfig
 
+        # Fleet health is always attached (observation is free); expiry +
+        # sweeping only activate when POD_TTL_S > 0.
+        self.fleet_health = FleetHealth(FleetHealthConfig(pod_ttl_s=cfg.pod_ttl_s))
         self.indexer = KVCacheIndexer(
             KVCacheIndexerConfig(
                 token_processor=TokenProcessorConfig(
@@ -122,10 +132,12 @@ class ScoringService:
                 ),
             ),
             tokenizer=tokenizer,
+            fleet_health=self.fleet_health,
         )
         self.events_pool = KVEventsPool(
             self.indexer.kv_block_index,
             KVEventsPoolConfig(concurrency=cfg.pool_concurrency),
+            health=self.fleet_health,
         )
         self.subscriber = ZMQSubscriber(
             self.events_pool,
@@ -139,13 +151,16 @@ class ScoringService:
         self.indexer.run()
         self.events_pool.start()
         self.subscriber.start()
+        self.fleet_health.start_sweeper(self.indexer.kv_block_index)
         log.info(
             "scoring service started",
             zmq=self.config.zmq_endpoint,
             block_size=self.config.block_size,
+            pod_ttl_s=self.config.pod_ttl_s,
         )
 
     def shutdown(self) -> None:
+        self.fleet_health.stop_sweeper()
         self.subscriber.shutdown()
         self.events_pool.shutdown()
         self.indexer.shutdown()
@@ -236,12 +251,32 @@ class ScoringService:
     async def handle_healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        """Self-healing observability: per-pod health + stream-integrity
+        counters (gaps/resyncs/sweeps/drops), subscriber drop counts, and
+        the index collector's shadow counters."""
+        from ..kvcache.metrics import collector
+
+        return web.json_response(
+            {
+                "fleet": self.fleet_health.snapshot(),
+                "subscriber": {
+                    "malformed_dropped": dict(self.subscriber.malformed_dropped),
+                },
+                "events_rejected_after_shutdown": (
+                    self.events_pool.rejected_after_shutdown
+                ),
+                "index": collector.snapshot(),
+            }
+        )
+
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/score_completions", self.handle_score_completions)
         app.router.add_post("/score_chat_completions", self.handle_score_chat_completions)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/healthz", self.handle_healthz)
+        app.router.add_get("/stats", self.handle_stats)
         return app
 
 

@@ -257,6 +257,16 @@ class BlockManager:
         )
         return page
 
+    # -- fleet self-healing (kvcache/kvevents resync) -----------------------
+    def block_digest(self) -> dict[str, list[int]]:
+        """Resync digest: every chain hash currently resident, per tier —
+        the ground truth an ``IndexSnapshot`` replaces the indexer's view
+        with. Caller must be the engine loop (page-pool ownership rule)."""
+        return {
+            "tpu_hbm": list(self._cached.keys()),
+            "host_dram": list(self._host_cached.keys()),
+        }
+
     # -- cross-pod transfer (kvcache/transfer) ------------------------------
     def is_block_resident(self, h: int) -> bool:
         """True when ``h`` lives in either tier (HBM page or host slot)."""

@@ -37,7 +37,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..kvcache.kvevents import ZMQPublisher, ZMQPublisherConfig
+from ..kvcache.kvevents import Heartbeat, IndexSnapshot, ZMQPublisher, ZMQPublisherConfig
 from ..kvcache.transfer import (
     KVTransferClient,
     KVTransferService,
@@ -172,6 +172,19 @@ class PodServerConfig:
     transfer_max_blocks: int = 64
     #: fetch deadline; an expired pull falls back to cold prefill
     transfer_timeout_s: float = 10.0
+    # -- fleet self-healing (all off by default = bit-identical legacy) ----
+    #: seconds between Heartbeat events (liveness beacon + publisher drop
+    #: report for the indexer's dead-pod sweep); 0 = no heartbeats.
+    heartbeat_interval_s: float = 0.0
+    #: seconds between periodic IndexSnapshot resyncs (replace-all-for-pod
+    #: digest of resident blocks per tier); 0 = no periodic resync.
+    resync_interval_s: float = 0.0
+    #: transfer circuit breaker: consecutive pull failures per peer before
+    #: the breaker opens and pulls skip straight to cold prefill; 0 = off.
+    transfer_breaker_failures: int = 0
+    #: first OPEN backoff; doubles per failed half-open probe (capped).
+    transfer_breaker_backoff_s: float = 1.0
+    transfer_breaker_backoff_max_s: float = 30.0
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -191,6 +204,28 @@ class PodServerConfig:
         )
         cfg.transfer_timeout_s = float(
             os.environ.get("TRANSFER_TIMEOUT_S", cfg.transfer_timeout_s)
+        )
+        # Fleet self-healing (0/unset = off, legacy behavior).
+        cfg.heartbeat_interval_s = float(
+            os.environ.get("HEARTBEAT_INTERVAL_S", cfg.heartbeat_interval_s)
+        )
+        cfg.resync_interval_s = float(
+            os.environ.get("RESYNC_INTERVAL_S", cfg.resync_interval_s)
+        )
+        cfg.transfer_breaker_failures = int(
+            os.environ.get(
+                "TRANSFER_BREAKER_FAILURES", cfg.transfer_breaker_failures
+            )
+        )
+        cfg.transfer_breaker_backoff_s = float(
+            os.environ.get(
+                "TRANSFER_BREAKER_BACKOFF_S", cfg.transfer_breaker_backoff_s
+            )
+        )
+        cfg.transfer_breaker_backoff_max_s = float(
+            os.environ.get(
+                "TRANSFER_BREAKER_BACKOFF_MAX_S", cfg.transfer_breaker_backoff_max_s
+            )
         )
 
         eng = cfg.engine
@@ -311,6 +346,15 @@ class PodServer:
         self._transfer_service: Optional[KVTransferService] = None
         self.transfer_pulls = 0  # pulls that imported >= 1 block
         self.transfer_pull_failures = 0  # fetch/import fell back to cold
+
+        # -- fleet self-healing (heartbeats + periodic resync) --------------
+        # Digest reads hop onto the engine loop like exports/imports: page
+        # bookkeeping is engine-loop-owned state.
+        self._digest_requests: deque[Future] = deque()
+        self.heartbeats_published = 0
+        self.snapshots_published = 0
+        self._self_heal_stop = threading.Event()
+        self._self_heal_thread: Optional[threading.Thread] = None
         if self.config.transfer_endpoint:
             self._transfer_service = KVTransferService(
                 TransferServiceConfig(
@@ -333,8 +377,21 @@ class PodServer:
         self._thread.start()
         if self._transfer_service is not None:
             self._transfer_service.start()
+        if self._publisher is not None and (
+            self.config.heartbeat_interval_s > 0
+            or self.config.resync_interval_s > 0
+        ):
+            self._self_heal_stop.clear()
+            self._self_heal_thread = threading.Thread(
+                target=self._self_heal_loop, name="self-heal", daemon=True
+            )
+            self._self_heal_thread.start()
 
     def shutdown(self) -> None:
+        self._self_heal_stop.set()
+        if self._self_heal_thread is not None:
+            self._self_heal_thread.join(timeout=5)
+            self._self_heal_thread = None
         if self._transfer_service is not None:
             self._transfer_service.shutdown()
         with self._work:
@@ -356,9 +413,14 @@ class PodServer:
         with self._mu:
             staged = list(self._staging)
             self._staging.clear()
-            transfers = list(self._transfer_exports) + list(self._transfer_imports)
+            transfers = (
+                list(self._transfer_exports)
+                + list(self._transfer_imports)
+                + [(fut,) for fut in self._digest_requests]
+            )
             self._transfer_exports.clear()
             self._transfer_imports.clear()
+            self._digest_requests.clear()
         for _, _, fut in staged:
             if not fut.done():
                 fut.set_exception(exc)
@@ -379,6 +441,7 @@ class PodServer:
                         self._staging
                         or self._transfer_exports
                         or self._transfer_imports
+                        or self._digest_requests
                         or self.engine.has_work
                     ):
                         self._work.wait(timeout=0.1)
@@ -390,10 +453,17 @@ class PodServer:
                     self._transfer_exports.clear()
                     imports = list(self._transfer_imports)
                     self._transfer_imports.clear()
+                    digests = list(self._digest_requests)
+                    self._digest_requests.clear()
                 # Engine state is owned by this thread — no lock held while
                 # admitting or stepping (device compute can take a while).
                 # Imports land before admissions so a request staged with
                 # its pull (pull_prefix -> submit) sees the warm pages.
+                for fut in digests:
+                    try:
+                        fut.set_result(self.engine.block_manager.block_digest())
+                    except Exception as e:
+                        fut.set_exception(e)
                 for blocks, fut in imports:
                     try:
                         fut.set_result(self.engine.import_kv_blocks(blocks))
@@ -436,6 +506,93 @@ class PodServer:
             log.error("engine loop died", error=repr(e))
             self._failed = f"{type(e).__name__}: {e}"
             self._fail_outstanding(RuntimeError(f"engine failed: {self._failed}"))
+
+    # -- fleet self-healing --------------------------------------------------
+    def _self_heal_loop(self) -> None:
+        """Heartbeat / periodic-resync publisher. Runs only when a knob is
+        enabled; all failures are swallowed — self-healing must never take
+        a serving pod down."""
+        hb = self.config.heartbeat_interval_s
+        rs = self.config.resync_interval_s
+        tick = min(x for x in (hb, rs) if x > 0)
+        next_hb = 0.0 if hb > 0 else float("inf")
+        # First snapshot goes out after one full interval: at process start
+        # the digest is empty and the normal event stream covers warm-up.
+        import time as _time
+
+        now = _time.monotonic()
+        next_rs = now + rs if rs > 0 else float("inf")
+        while not self._self_heal_stop.wait(min(tick, 0.25)):
+            now = _time.monotonic()
+            if now >= next_hb:
+                next_hb = now + hb
+                self._publish_heartbeat()
+            if now >= next_rs:
+                next_rs = now + rs
+                # Fire-and-forget: the snapshot publishes from the engine
+                # loop when the digest resolves. Blocking here would starve
+                # heartbeats behind a long device step — a slow resync must
+                # never make a live pod look dead.
+                self.publish_index_snapshot(wait=False)
+
+    def _publish_heartbeat(self) -> None:
+        if self._publisher is None:
+            return
+        try:
+            self._publisher.publish(
+                [
+                    Heartbeat(
+                        dropped_batches=getattr(
+                            self._publisher, "dropped_batches", 0
+                        )
+                    )
+                ]
+            )
+            self.heartbeats_published += 1
+        except Exception:
+            log.exception("heartbeat publish failed")
+
+    def publish_index_snapshot(
+        self, timeout_s: float = 30.0, wait: bool = True
+    ) -> bool:
+        """Emit an ``IndexSnapshot`` resync. The digest is read AND
+        published on the engine loop (digest-future callback), so no
+        ``BlockStored``/``BlockRemoved`` the loop emits can interleave
+        between reading the digest and shipping it — a stale snapshot
+        would silently wipe the interleaved event from the index. Callable
+        on demand (e.g. after the indexer flags this pod suspect) and
+        periodically via ``RESYNC_INTERVAL_S`` (which passes ``wait=False``
+        so a slow engine step can't starve heartbeats)."""
+        if self._publisher is None:
+            return False
+        done: Future = Future()
+
+        def on_digest(f: Future) -> None:
+            # Runs where the future is settled: the engine loop (ordered
+            # with the event stream) or the failure path.
+            try:
+                digest = f.result()
+                self._publisher.publish([IndexSnapshot(blocks_by_medium=digest)])
+                self.snapshots_published += 1
+                done.set_result(True)
+            except Exception:
+                log.exception("index snapshot publish failed")
+                done.set_result(False)
+
+        fut: Future = Future()
+        fut.add_done_callback(on_digest)
+        with self._work:
+            if not self._running or self._failed is not None:
+                return False
+            self._digest_requests.append(fut)
+            self._work.notify()
+        if not wait:
+            return True
+        try:
+            return done.result(timeout=timeout_s)
+        except Exception:
+            log.exception("index snapshot publish timed out")
+            return False
 
     # -- cross-pod KV transfer ----------------------------------------------
     def _observe_transfer_sample(self, n_bytes: int, seconds: float) -> None:
@@ -491,6 +648,11 @@ class PodServer:
                     TransferClientConfig(
                         endpoint=source_endpoint,
                         timeout_s=self.config.transfer_timeout_s,
+                        breaker_failures=self.config.transfer_breaker_failures,
+                        breaker_backoff_s=self.config.transfer_breaker_backoff_s,
+                        breaker_backoff_max_s=(
+                            self.config.transfer_breaker_backoff_max_s
+                        ),
                     ),
                     on_sample=self._observe_transfer_sample,
                 )
@@ -641,6 +803,15 @@ class PodServer:
             bm = self.engine.block_manager
             with self._mu:
                 staged = len(self._staging)
+                breakers = {
+                    ep: client.breaker.snapshot()
+                    for ep, client in self._transfer_clients.items()
+                    if client.breaker is not None
+                }
+                breaker_skips = sum(
+                    client.breaker_skips
+                    for client in self._transfer_clients.values()
+                )
             payload = {
                 "pod": self.config.pod_identifier,
                 "model": self.config.model_name,
@@ -656,10 +827,21 @@ class PodServer:
                     "endpoint": self.config.transfer_endpoint,
                     "pulls": self.transfer_pulls,
                     "pull_failures": self.transfer_pull_failures,
+                    "breaker_skips": breaker_skips,
+                    "breakers": breakers,
                     "requests_served": (
                         self._transfer_service.requests_served
                         if self._transfer_service
                         else 0
+                    ),
+                },
+                "self_heal": {
+                    "heartbeat_interval_s": self.config.heartbeat_interval_s,
+                    "resync_interval_s": self.config.resync_interval_s,
+                    "heartbeats_published": self.heartbeats_published,
+                    "snapshots_published": self.snapshots_published,
+                    "event_batches_dropped": getattr(
+                        self._publisher, "dropped_batches", 0
                     ),
                 },
             }

@@ -1,0 +1,176 @@
+"""Fault-injection harness for fleet self-healing tests.
+
+The chaos suite's contract (ISSUE 3): after ANY injected fault — dropped
+event batches, pod crash, network partition, delayed delivery, dead
+transfer peers — the fleet must converge back to truth (index == engine
+ground truth after at most one resync) and every degraded path must end in
+cold prefill, never an error.
+
+This module provides the injection points:
+
+- ``ChaosLink``: the in-process transport between one pod's publisher and
+  the indexer's event pool (the ``PoolPublisher`` idiom from
+  ``test_dp_fleet.py``), with the REAL wire contract — msgpack
+  ``EventBatch`` payloads and a per-publisher monotone ``seq`` that is
+  consumed even for dropped batches, exactly like ``ZMQPublisher`` — plus
+  fault controls: drop-next-N, partition/heal, delay-next-N with explicit
+  release.
+- Ground-truth helpers: ``engine_truth`` (the pod's block digest),
+  ``index_view_of_pod`` (what the index believes the pod holds), and
+  ``wait_until`` for convergence polling.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import Key
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    BlockStored,
+    EventBatch,
+    IndexSnapshot,
+    Message,
+)
+
+
+class ChaosLink:
+    """Publisher → pool transport with fault injection.
+
+    Duck-types enough of ``ZMQPublisher`` for ``PodServer`` injection:
+    ``publish(events, ts=None) -> seq``, ``close()``, ``dropped_batches``,
+    and a ``config`` carrying ``data_parallel_rank``.
+    """
+
+    def __init__(self, pool, pod_identifier, model_name, dp_rank=None):
+        self.pool = pool
+        self.pod_identifier = pod_identifier
+        self.model_name = model_name
+        self.config = type(
+            "C",
+            (),
+            {
+                "data_parallel_rank": dp_rank,
+                "pod_identifier": pod_identifier,
+                "model_name": model_name,
+            },
+        )()
+        self.topic = f"kv@{pod_identifier}@{model_name}"
+        self._mu = threading.Lock()
+        self._seq = 0
+        self.dropped_batches = 0
+        self._drop_next = 0
+        self._partitioned = False
+        self._delay_next = 0
+        self._held: list[Message] = []
+        #: every block hash this link ever carried (incl. in dropped
+        #: batches): the universe convergence checks compare over.
+        self.seen_hashes: set[int] = set()
+
+    # -- fault controls ------------------------------------------------------
+    def drop_next(self, n: int = 1) -> None:
+        """Drop the next ``n`` batches (transport loss: seq still consumed,
+        as the real publisher does after bounded retries)."""
+        with self._mu:
+            self._drop_next += n
+
+    def partition(self) -> None:
+        """Drop everything until ``heal()`` — a network partition as the
+        indexer experiences it."""
+        with self._mu:
+            self._partitioned = True
+
+    def heal(self) -> None:
+        with self._mu:
+            self._partitioned = False
+
+    def delay_next(self, n: int = 1) -> None:
+        """Hold the next ``n`` messages instead of delivering; they keep
+        their seq and deliver (late, possibly out of order relative to
+        later traffic) on ``release_held()``."""
+        with self._mu:
+            self._delay_next += n
+
+    def release_held(self) -> int:
+        """Deliver all held messages; returns how many."""
+        with self._mu:
+            held, self._held = self._held, []
+        for msg in held:
+            self.pool.add_task(msg)
+        return len(held)
+
+    # -- publisher contract --------------------------------------------------
+    def publish(self, events, ts=None) -> int:
+        batch = EventBatch(
+            ts=ts if ts is not None else time.time(),
+            events=list(events),
+            data_parallel_rank=self.config.data_parallel_rank,
+        )
+        payload = batch.to_payload()
+        for ev in batch.events:
+            if isinstance(ev, BlockStored):
+                self.seen_hashes.update(int(h) for h in ev.block_hashes)
+            elif isinstance(ev, IndexSnapshot):
+                for hashes in ev.blocks_by_medium.values():
+                    self.seen_hashes.update(int(h) for h in hashes)
+        with self._mu:
+            seq = self._seq
+            self._seq += 1  # consumed even when the batch is lost
+            if self._partitioned or self._drop_next > 0:
+                if self._drop_next > 0:
+                    self._drop_next -= 1
+                self.dropped_batches += 1
+                return -1
+            delay = self._delay_next > 0
+            if delay:
+                self._delay_next -= 1
+        msg = Message(
+            topic=self.topic,
+            pod_identifier=self.pod_identifier,
+            model_name=self.model_name,
+            payload=payload,
+            seq=seq,
+        )
+        if delay:
+            with self._mu:
+                self._held.append(msg)
+            return seq
+        self.pool.add_task(msg)
+        return seq
+
+    def close(self) -> None:
+        pass
+
+
+# -- ground truth vs index view ---------------------------------------------
+def engine_truth(server) -> set[int]:
+    """Every chain hash resident on the pod, across tiers (the digest a
+    resync would publish). Reads bookkeeping dicts directly — only call
+    when the pod is quiescent (no in-flight requests)."""
+    digest = server.engine.block_manager.block_digest()
+    return {int(h) for hashes in digest.values() for h in hashes}
+
+
+def index_view_of_pod(index, model_name, universe, pod) -> set[int]:
+    """Subset of ``universe`` the index currently attributes to ``pod``.
+
+    Looks keys up one at a time so a present-but-empty key cannot
+    early-stop the scan over an arbitrary (unordered) universe.
+    """
+    view = set()
+    for h in universe:
+        key = Key(model_name, int(h))
+        got = index.lookup([key], set())
+        if pod in got.get(key, []):
+            view.add(int(h))
+    return view
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02) -> bool:
+    """Poll ``predicate`` until true or timeout; returns the final value."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())

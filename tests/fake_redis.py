@@ -61,3 +61,12 @@ class FakeRedis:
     def hkeys(self, name):
         with self._lock:
             return list(self._hashes.get(name, {}).keys())
+
+    def keys(self):
+        with self._lock:
+            return list(self._hashes.keys())
+
+    def scan_iter(self, match=None):
+        """SCAN subset used by ``RedisIndex.evict_pod`` (match unused)."""
+        for name in self.keys():
+            yield name

@@ -1,0 +1,712 @@
+"""Fleet self-healing chaos suite (ISSUE 3 acceptance).
+
+Invariant under every injected fault — dropped event batches, pod crash,
+partition, delayed delivery, dead transfer peers: the index converges back
+to engine ground truth within one resync, no routing decision targets an
+expired pod, and every degraded path ends in cold prefill with correct
+output, never an error.
+
+Fault injection lives in ``tests/chaos.py``; everything here runs through
+the real wire encoding (msgpack EventBatch → sharded KVEventsPool → index)
+and, for the engine-backed scenarios, real ``PodServer`` instances in
+Pallas interpreter mode.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from chaos import ChaosLink, engine_truth, index_view_of_pod, wait_until
+from llm_d_kv_cache_manager_tpu.kvcache import (
+    BlendedRouter,
+    KVCacheIndexer,
+    KVCacheIndexerConfig,
+    PrefixAffinityTracker,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    CostAwareMemoryIndex,
+    CostAwareMemoryIndexConfig,
+    InMemoryIndex,
+    InMemoryIndexConfig,
+    RedisIndexConfig,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import RedisIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    BlockRemoved,
+    BlockStored,
+    FleetHealth,
+    FleetHealthConfig,
+    Heartbeat,
+    IndexSnapshot,
+    KVEventsPool,
+    KVEventsPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.transfer import (
+    CircuitBreaker,
+    KVTransferClient,
+    TransferClientConfig,
+    TransferError,
+)
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    EngineConfig,
+    SamplingParams,
+    SchedulerConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.serve import PodServer, PodServerConfig
+
+from fake_redis import FakeRedis
+
+PS = 4
+MODEL = "tiny-llama"
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _stored(hashes, medium="tpu_hbm"):
+    return [BlockStored(block_hashes=list(hashes), block_size=PS, medium=medium)]
+
+
+def _pod_config(pod_id, **kw):
+    return PodServerConfig(
+        model_name=MODEL,
+        pod_identifier=pod_id,
+        publish_events=False,
+        engine=EngineConfig(
+            model=TINY_LLAMA,
+            block_manager=BlockManagerConfig(total_pages=64, page_size=PS),
+            scheduler=SchedulerConfig(max_prefill_batch=4),
+            max_model_len=64,
+            decode_batch_size=4,
+            prefill_bucket=8,
+            interpret=True,
+        ),
+        **kw,
+    )
+
+
+def _prompt(seed, n):
+    return list(
+        map(int, np.random.default_rng(seed).integers(0, TINY_LLAMA.vocab_size, n))
+    )
+
+
+@pytest.fixture
+def plane():
+    """Event plane with health attached: (index, pool, health, clock)."""
+    clock = FakeClock()
+    health = FleetHealth(FleetHealthConfig(pod_ttl_s=5.0), clock=clock)
+    index = InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=8))
+    pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=2), health=health)
+    pool.start()
+    yield index, pool, health, clock
+    pool.shutdown()
+
+
+class TestGapDetectionAndResync:
+    """Fault: a dropped event batch. Detection: seq gap. Repair: snapshot
+    resync (replace-all-for-pod) — the index converges back to truth."""
+
+    def test_drop_detected_and_resync_heals(self, plane):
+        index, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-a", MODEL)
+
+        link.publish(_stored([1, 2, 3]))
+        link.drop_next(1)
+        link.publish([BlockRemoved(block_hashes=[2])])  # lost on the wire
+        link.publish(_stored([4]))
+        assert pool.drain()
+
+        # The gap is visible, the pod suspect — and the index is WRONG
+        # (phantom block 2): exactly the rot resync exists to repair.
+        assert health.gaps_detected == 1
+        assert health.is_suspect("pod-a")
+        assert index_view_of_pod(index, MODEL, link.seen_hashes, "pod-a") == {1, 2, 3, 4}
+
+        # Ground truth after the lost eviction: {1, 3, 4}.
+        link.publish([IndexSnapshot(blocks_by_medium={"tpu_hbm": [1, 3, 4]})])
+        assert pool.drain()
+        assert index_view_of_pod(index, MODEL, link.seen_hashes, "pod-a") == {1, 3, 4}
+        assert not health.is_suspect("pod-a")
+        assert health.resyncs_applied == 1
+
+    def test_in_order_stream_flags_nothing(self, plane):
+        _, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-a", MODEL)
+        for i in range(10):
+            link.publish(_stored([i]))
+        assert pool.drain()
+        assert health.gaps_detected == 0
+        assert not health.is_suspect("pod-a")
+
+    def test_delayed_out_of_order_delivery_detected_and_healed(self, plane):
+        index, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-b", MODEL)
+        link.publish(_stored([10, 11]))
+        link.delay_next(1)
+        link.publish([BlockRemoved(block_hashes=[11])])  # held → late
+        link.publish(_stored([12]))  # seq jumps past the held message
+        assert pool.drain()
+        assert health.gaps_detected >= 1  # the hole where the held seq was
+
+        link.release_held()  # now arrives with a REGRESSED seq
+        assert pool.drain()
+        assert health.is_suspect("pod-b")
+
+        link.publish([IndexSnapshot(blocks_by_medium={"tpu_hbm": [10, 12]})])
+        assert pool.drain()
+        assert index_view_of_pod(index, MODEL, link.seen_hashes, "pod-b") == {10, 12}
+        assert not health.is_suspect("pod-b")
+
+    def test_regression_gap_count_is_bounded(self, plane):
+        """A regressed seq flags a gap and REBASES the stream: a genuine
+        straggler costs at most one extra catch-up gap, after which an
+        in-order stream flags nothing further."""
+        _, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-s", MODEL)
+        for i in range(5):
+            link.publish(_stored([i]))  # seqs 0..4
+        link.delay_next(1)
+        link.publish(_stored([5]))  # seq 5 held
+        link.publish(_stored([6]))  # seq 6 → gap #1 (hole at 5)
+        assert pool.drain()
+        assert health.gaps_detected == 1
+
+        link.release_held()  # seq 5 arrives late → regression gap #2
+        assert pool.drain()
+        assert health.gaps_detected == 2
+
+        link.publish(_stored([7]))  # catch-up vs rebased stream → gap #3
+        link.publish(_stored([8]))  # in-order from here: stable
+        link.publish(_stored([9]))
+        assert pool.drain()
+        assert health.gaps_detected == 3  # bounded — no per-message storm
+
+    def test_restart_with_lost_seq0_flags_one_gap_not_a_storm(self, plane):
+        """Publisher restart whose seq-0 batch is itself lost: the first
+        surviving message flags ONE gap and rebases; the rest of the new
+        stream must NOT each count as a regression against the old
+        high-water mark."""
+        _, pool, health, _ = plane
+        old = ChaosLink(pool, "pod-w", MODEL)
+        for i in range(50):
+            old.publish(_stored([i]))  # old stream: seqs 0..49
+        assert pool.drain()
+        assert health.gaps_detected == 0
+
+        fresh = ChaosLink(pool, "pod-w", MODEL)  # restart: seq resets
+        fresh.drop_next(1)
+        fresh.publish(_stored([100]))  # seq 0 LOST in transit
+        for i in range(1, 6):
+            fresh.publish(_stored([100 + i]))  # seqs 1..5 delivered
+        assert pool.drain()
+        assert health.gaps_detected == 1  # one rebase, then in-order
+        assert health.is_suspect("pod-w")  # repair still triggered
+
+    def test_heartbeat_drop_counter_rebases_on_restart(self, plane):
+        """A restarted publisher's dropped_batches counter restarts at 0;
+        the baseline must rebase or its first drops are masked forever."""
+        _, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-h", MODEL)
+        link.publish([Heartbeat(dropped_batches=7)])
+        assert pool.drain()
+        assert health.publisher_drops_reported == 7
+
+        # Restart: counter back to 0 — not new drops, a new baseline.
+        link.publish([Heartbeat(dropped_batches=0)])
+        assert pool.drain()
+        assert health.publisher_drops_reported == 7
+
+        link.publish([Heartbeat(dropped_batches=2)])  # 2 real new drops
+        assert pool.drain()
+        assert health.publisher_drops_reported == 9
+
+    def test_publisher_restart_resets_without_gap(self, plane):
+        _, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-r", MODEL)
+        for i in range(4):
+            link.publish(_stored([i]))  # seqs 0..3
+        assert pool.drain()
+        before = health.gaps_detected
+        # Publisher restart: a fresh stream starts at seq 0 again.
+        fresh = ChaosLink(pool, "pod-r", MODEL)
+        fresh.publish(_stored([9]))   # seq 0: restart, not loss
+        fresh.publish(_stored([10]))  # seq 1: in-order on the new stream
+        assert pool.drain()
+        assert health.gaps_detected == before
+
+    def test_snapshot_clears_stale_tiers_and_models(self, plane):
+        """Replace-all-for-pod means ALL of the pod's entries — every tier,
+        every model — are rebuilt from the digest."""
+        index, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-c", MODEL)
+        other = ChaosLink(pool, "pod-d", MODEL)
+        link.publish(_stored([1], medium="tpu_hbm"))
+        link.publish(_stored([2], medium="host_dram"))
+        other.publish(_stored([1, 2]))  # a different pod's entries survive
+        assert pool.drain()
+
+        link.publish(
+            [IndexSnapshot(blocks_by_medium={"tpu_hbm": [3], "host_dram": []})]
+        )
+        assert pool.drain()
+        assert index_view_of_pod(index, MODEL, {1, 2, 3}, "pod-c") == {3}
+        assert index_view_of_pod(index, MODEL, {1, 2, 3}, "pod-d") == {1, 2}
+
+
+class TestPublisherDropReporting:
+    def test_heartbeat_reported_drops_mark_suspect(self, plane):
+        _, pool, health, _ = plane
+        link = ChaosLink(pool, "pod-a", MODEL)
+        link.publish([Heartbeat(dropped_batches=0)])
+        assert pool.drain()
+        assert health.heartbeats_seen == 1
+        assert not health.is_suspect("pod-a")
+
+        # The publisher dropped 2 batches since the last beat — even with
+        # no seq gap ever observable (idle stream), loss is detected.
+        link.publish([Heartbeat(dropped_batches=2)])
+        assert pool.drain()
+        assert health.is_suspect("pod-a")
+        assert health.publisher_drops_reported == 2
+
+        link.publish([IndexSnapshot(blocks_by_medium={})])
+        assert pool.drain()
+        assert not health.is_suspect("pod-a")
+
+    def test_publisher_seq_skips_on_drop(self, monkeypatch):
+        """The real ZMQPublisher consumes a seq for a dropped batch, so the
+        next delivered message exposes the gap (satellite 1)."""
+        import zmq
+
+        from conftest import free_tcp_port
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+            ZMQPublisher,
+            ZMQPublisherConfig,
+        )
+
+        pub = ZMQPublisher(
+            ZMQPublisherConfig(endpoint=f"tcp://localhost:{free_tcp_port()}")
+        )
+        assert pub.publish(_stored([1])) == 0
+
+        def dead(frames):
+            raise zmq.ZMQError()
+
+        monkeypatch.setattr(pub._sock, "send_multipart", dead)
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        assert pub.publish(_stored([2])) == -1  # dropped, seq 1 consumed
+        assert pub.dropped_batches == 1
+        monkeypatch.setattr(pub._sock, "send_multipart", lambda frames: None)
+        assert pub.publish(_stored([3])) == 2  # the gap at seq 1 is visible
+        pub.close()
+
+
+SWEEP_BACKENDS = {
+    "in_memory": lambda: InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=8)),
+    "cost_aware": lambda: CostAwareMemoryIndex(
+        CostAwareMemoryIndexConfig(max_cost_bytes=10**6)
+    ),
+    "redis": lambda: RedisIndex(RedisIndexConfig(client=FakeRedis())),
+}
+
+
+class TestDeadPodSweep:
+    @pytest.mark.parametrize("backend", list(SWEEP_BACKENDS))
+    def test_ttl_sweep_evicts_only_the_dead_pod(self, backend):
+        clock = FakeClock()
+        health = FleetHealth(FleetHealthConfig(pod_ttl_s=5.0), clock=clock)
+        index = SWEEP_BACKENDS[backend]()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1), health=health)
+        pool.start()
+        try:
+            dead = ChaosLink(pool, "pod-dead", MODEL)
+            live = ChaosLink(pool, "pod-live", MODEL)
+            dead.publish(_stored([1, 2]))
+            live.publish(_stored([2, 3]))
+            assert pool.drain()
+
+            clock.advance(6.0)  # pod-dead goes silent past TTL...
+            live.publish([Heartbeat()])  # ...pod-live keeps beating
+            assert pool.drain()
+
+            assert health.sweep(index) == ["pod-dead"]
+            assert health.pods_swept == 1
+            assert index_view_of_pod(index, MODEL, {1, 2, 3}, "pod-dead") == set()
+            assert index_view_of_pod(index, MODEL, {1, 2, 3}, "pod-live") == {2, 3}
+            assert health.sweep(index) == []  # idempotent until revival
+
+            # Revival: new events bring the pod back.
+            dead.publish(_stored([7]))
+            assert pool.drain()
+            assert not health.is_expired("pod-dead")
+            assert index_view_of_pod(index, MODEL, {7}, "pod-dead") == {7}
+        finally:
+            pool.shutdown()
+
+    def test_background_sweeper_thread(self):
+        health = FleetHealth(
+            FleetHealthConfig(pod_ttl_s=0.2, sweep_interval_s=0.05)
+        )
+        index = InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=4))
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1), health=health)
+        pool.start()
+        link = ChaosLink(pool, "pod-x", MODEL)
+        try:
+            link.publish(_stored([1]))
+            assert pool.drain()
+            health.start_sweeper(index)
+            assert wait_until(lambda: health.pods_swept >= 1, timeout=10)
+            assert index_view_of_pod(index, MODEL, {1}, "pod-x") == set()
+        finally:
+            health.stop_sweeper()
+            pool.shutdown()
+
+    def test_failed_sweep_retries_next_pass(self):
+        """A backend error during evict_pod must not permanently strand the
+        dead pod's entries: the pod is un-marked and the next sweep retries
+        (routing stays safe meanwhile via the TTL check)."""
+
+        class FlakyIndex(InMemoryIndex):
+            def __init__(self):
+                super().__init__(InMemoryIndexConfig(size=100, pod_cache_size=4))
+                self.fail_next = 1
+
+            def evict_pod(self, pod):
+                if self.fail_next:
+                    self.fail_next -= 1
+                    raise RuntimeError("transient backend error")
+                return super().evict_pod(pod)
+
+        clock = FakeClock()
+        health = FleetHealth(FleetHealthConfig(pod_ttl_s=5.0), clock=clock)
+        index = FlakyIndex()
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.keys import Key as K
+
+        index.add([K(MODEL, 1)], [PodEntry("pod-f")])
+        health.observe_message("pod-f", MODEL, 0)
+        clock.advance(6.0)
+
+        assert health.sweep(index) == []  # first pass: backend error
+        assert health.is_expired("pod-f")  # still hidden from routing
+        assert health.sweep(index) == ["pod-f"]  # retried and landed
+        assert index_view_of_pod(index, MODEL, {1}, "pod-f") == set()
+
+    def test_ttl_zero_never_expires(self):
+        clock = FakeClock()
+        health = FleetHealth(FleetHealthConfig(pod_ttl_s=0.0), clock=clock)
+        health.observe_message("pod-a", MODEL, 0)
+        clock.advance(10_000)
+        assert not health.is_expired("pod-a")
+        index = InMemoryIndex()
+        assert health.sweep(index) == []
+
+
+class TestExpiredPodNeverRouted:
+    """The read-path guarantee: between TTL expiry and the sweep landing,
+    scores already exclude the dead pod — and the router degrades to a
+    cold placement, never an error."""
+
+    def _indexer_with_health(self, clock):
+        health = FleetHealth(FleetHealthConfig(pod_ttl_s=5.0), clock=clock)
+        indexer = KVCacheIndexer(
+            KVCacheIndexerConfig(
+                token_processor=TokenProcessorConfig(block_size=PS)
+            ),
+            fleet_health=health,
+        )
+        return indexer, health
+
+    def test_sole_matching_pod_expired_mid_lookup(self):
+        clock = FakeClock()
+        indexer, health = self._indexer_with_health(clock)
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry
+
+        tokens = list(range(16))
+        keys = indexer.token_processor.tokens_to_kv_block_keys(tokens, MODEL)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-only")])
+        health.observe_message("pod-only", MODEL, 0)
+
+        assert indexer.score_tokens(tokens, MODEL) == {"pod-only": len(keys)}
+        clock.advance(6.0)  # TTL passes; the sweeper has NOT run yet
+        assert indexer.score_tokens(tokens, MODEL) == {}
+
+    def test_router_degrades_to_cold_not_error(self):
+        clock = FakeClock()
+        indexer, health = self._indexer_with_health(clock)
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry
+
+        tokens = list(range(16))
+        keys = indexer.token_processor.tokens_to_kv_block_keys(tokens, MODEL)
+        indexer.kv_block_index.add(keys, [PodEntry("pod-warm")])
+        health.observe_message("pod-warm", MODEL, 0)
+
+        pods = ["pod-warm", "pod-cold"]
+        router = BlendedRouter(
+            score_fn=lambda toks, p: indexer.score_tokens(toks, MODEL, p),
+            affinity=PrefixAffinityTracker(n_pods=2, capacity_blocks=64),
+            loads_fn=lambda p: [0.0] * len(p),
+        )
+        assert router.route(tokens, pods).pod == "pod-warm"
+        clock.advance(6.0)  # pod-warm dies
+        decision = router.route(tokens, pods)
+        assert decision.pod != "pod-warm" or decision.index_score == 0
+        # With zero index signal everywhere, the router must still place
+        # the request (affinity seeded pod-warm earlier, but index says
+        # nothing) — the point is: a decision, not an exception.
+        assert decision.pod in pods
+
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        clock = FakeClock()
+        b = CircuitBreaker(2, backoff_s=1.0, backoff_max_s=4.0, clock=clock)
+        assert b.state == "closed" and b.allow()
+        b.record_failure()
+        assert b.state == "closed" and b.allow()
+        b.record_failure()  # threshold: trips
+        assert b.state == "open" and b.opens == 1
+        assert not b.allow()
+
+        clock.advance(1.1)  # backoff expires → one half-open probe
+        assert b.state == "half_open"
+        assert b.allow()
+        assert not b.allow()  # only one probe in flight
+        b.record_failure()  # probe fails → reopen, backoff doubles
+        assert not b.allow()
+        clock.advance(1.1)
+        assert not b.allow()  # 2s backoff now
+        clock.advance(1.0)
+        assert b.allow()
+        b.record_success()  # probe succeeds → closed, backoff reset
+        assert b.state == "closed" and b.closes == 1
+        assert b.allow()
+
+    def test_backoff_caps(self):
+        clock = FakeClock()
+        b = CircuitBreaker(1, backoff_s=1.0, backoff_max_s=4.0, clock=clock)
+        for _ in range(6):  # repeated failed probes
+            b.record_failure()
+            clock.advance(100.0)
+            assert b.allow()
+        assert b.snapshot()["backoff_s"] == 4.0
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(3, clock=FakeClock())
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"  # never saw 3 consecutive
+
+    def test_open_breaker_fails_fetch_instantly(self):
+        from conftest import free_tcp_port
+
+        client = KVTransferClient(
+            TransferClientConfig(
+                endpoint=f"tcp://127.0.0.1:{free_tcp_port()}",
+                timeout_s=0.4,
+                breaker_failures=1,
+            )
+        )
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(TransferError):
+                client.fetch(MODEL, [1, 2, 3])
+            assert time.perf_counter() - t0 >= 0.35  # ate the real timeout
+
+            t0 = time.perf_counter()
+            with pytest.raises(TransferError):
+                client.fetch(MODEL, [1, 2, 3])
+            # Breaker open: instant rejection, no second timeout burned.
+            assert time.perf_counter() - t0 < 0.2
+            assert client.breaker_skips == 1
+            assert client.breaker.snapshot()["state"] == "open"
+        finally:
+            client.close()
+
+
+class TestEngineFleetChaos:
+    """Engine-backed scenarios: real PodServers (interpreter mode) with
+    ChaosLink transports into one indexer."""
+
+    def _fleet(self, n=2, ttl_s=5.0, clock=None, **pod_kw):
+        clock = clock or FakeClock()
+        health = FleetHealth(FleetHealthConfig(pod_ttl_s=ttl_s), clock=clock)
+        indexer = KVCacheIndexer(
+            KVCacheIndexerConfig(
+                token_processor=TokenProcessorConfig(block_size=PS)
+            ),
+            fleet_health=health,
+        )
+        pool = KVEventsPool(
+            indexer.kv_block_index, KVEventsPoolConfig(concurrency=2), health=health
+        )
+        pool.start()
+        servers, links = [], []
+        for i in range(n):
+            pod_id = f"chaos-pod-{i}"
+            link = ChaosLink(pool, pod_id, MODEL)
+            server = PodServer(_pod_config(pod_id, **pod_kw), publisher=link)
+            server.start()
+            servers.append(server)
+            links.append(link)
+        return indexer, pool, health, clock, servers, links
+
+    def _teardown(self, pool, servers):
+        for s in servers:
+            s.shutdown()
+        pool.shutdown()
+
+    def test_pod_crash_swept_and_rerouted_cold(self):
+        indexer, pool, health, clock, servers, links = self._fleet(n=2)
+        try:
+            prefix = _prompt(0, 16)
+            baseline = servers[0].generate(
+                prefix, SamplingParams(max_new_tokens=3), timeout=120
+            )
+            assert pool.drain(timeout=10)
+            pods = ["chaos-pod-0", "chaos-pod-1"]
+            assert indexer.score_tokens(prefix, MODEL, pods)["chaos-pod-0"] > 0
+
+            # CRASH pod 0: no eviction events, no goodbyes — then silence
+            # past the TTL while pod 1 stays live.
+            servers[0].shutdown()
+            clock.advance(6.0)
+            links[1].publish([Heartbeat()])
+            assert pool.drain(timeout=10)
+
+            # Expiry guard: even before the sweep, scoring excludes it.
+            assert indexer.score_tokens(prefix, MODEL, pods) == {}
+            assert health.sweep(indexer.kv_block_index) == ["chaos-pod-0"]
+            assert (
+                index_view_of_pod(
+                    indexer.kv_block_index, MODEL, links[0].seen_hashes, "chaos-pod-0"
+                )
+                == set()
+            )
+
+            # Routing degrades to a cold placement on the survivor; the
+            # request completes with the SAME greedy output (engines share
+            # init seed) — degraded, never wrong, never an error.
+            router = BlendedRouter(
+                score_fn=lambda toks, p: indexer.score_tokens(toks, MODEL, p),
+                affinity=PrefixAffinityTracker(n_pods=2, capacity_blocks=64),
+                loads_fn=lambda p: [0.0] * len(p),
+            )
+            decision = router.route(prefix, pods)
+            assert decision.index_score == 0  # nobody advertises warmth
+            seq = servers[1].generate(
+                prefix, SamplingParams(max_new_tokens=3), timeout=120
+            )
+            assert seq.output_tokens == baseline.output_tokens
+            assert seq.num_cached_prompt == 0  # honest cold prefill
+        finally:
+            self._teardown(pool, servers)
+
+    def test_partition_heals_via_resync_to_ground_truth(self):
+        indexer, pool, health, clock, servers, links = self._fleet(n=1)
+        try:
+            server, link = servers[0], links[0]
+            server.generate(_prompt(1, 16), SamplingParams(max_new_tokens=2), timeout=120)
+            assert pool.drain(timeout=10)
+
+            # Partition: everything published during this window is lost —
+            # stores AND evictions desync arbitrarily.
+            link.partition()
+            for i in range(3):
+                server.generate(
+                    _prompt(10 + i, 24), SamplingParams(max_new_tokens=2), timeout=120
+                )
+            link.heal()
+
+            # One on-demand resync repairs the whole window: the snapshot
+            # message's seq jump flags the gap AND carries the fix.
+            assert server.publish_index_snapshot(timeout_s=30)
+            assert pool.drain(timeout=10)
+            assert health.gaps_detected >= 1
+            assert health.resyncs_applied == 1
+
+            truth = engine_truth(server)
+            view = index_view_of_pod(
+                indexer.kv_block_index, MODEL, link.seen_hashes, "chaos-pod-0"
+            )
+            assert view == truth
+        finally:
+            self._teardown(pool, servers)
+
+    def test_periodic_resync_converges_after_drops(self):
+        """RESYNC_INTERVAL_S acceptance: with periodic resync on, an
+        arbitrary drop fault converges without any operator action within
+        one interval."""
+        indexer, pool, health, clock, servers, links = self._fleet(
+            n=1, resync_interval_s=0.3, heartbeat_interval_s=0.2
+        )
+        try:
+            server, link = servers[0], links[0]
+            link.drop_next(2)  # lose the first prefill's event batches
+            server.generate(_prompt(2, 16), SamplingParams(max_new_tokens=2), timeout=120)
+
+            def converged():
+                pool.drain(timeout=2)
+                truth = engine_truth(server)
+                view = index_view_of_pod(
+                    indexer.kv_block_index, MODEL, link.seen_hashes, "chaos-pod-0"
+                )
+                return view == truth and truth
+
+            assert wait_until(converged, timeout=30)
+            assert server.snapshots_published >= 1
+            assert server.heartbeats_published >= 1
+            assert health.heartbeats_seen >= 1
+        finally:
+            self._teardown(pool, servers)
+
+    def test_dead_transfer_peer_breaker_then_cold_prefill(self):
+        from conftest import free_tcp_port
+
+        cold = PodServer(_pod_config("breaker-cold"))
+        cold.config.transfer_timeout_s = 0.4
+        cold.config.transfer_breaker_failures = 1
+        ref = PodServer(_pod_config("breaker-ref"))
+        cold.start(), ref.start()
+        try:
+            prompt = _prompt(3, 12)
+            peer = f"tcp://127.0.0.1:{free_tcp_port()}"  # nobody home
+
+            t0 = time.perf_counter()
+            assert cold.pull_prefix(prompt, peer) == 0  # eats one timeout
+            first = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            assert cold.pull_prefix(prompt, peer) == 0  # breaker: instant
+            second = time.perf_counter() - t0
+            assert first >= 0.35 and second < 0.2
+            assert cold.transfer_pull_failures == 2
+
+            client = cold._transfer_clients[peer]
+            assert client.breaker is not None
+            assert client.breaker.snapshot()["state"] == "open"
+            assert client.breaker_skips == 1
+
+            # The degraded request still completes, cold and correct.
+            s = cold.generate(prompt, SamplingParams(max_new_tokens=4), timeout=120)
+            s_ref = ref.generate(prompt, SamplingParams(max_new_tokens=4), timeout=120)
+            assert s.output_tokens == s_ref.output_tokens
+            assert s.num_cached_prompt == 0
+        finally:
+            cold.shutdown(), ref.shutdown()

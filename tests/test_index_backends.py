@@ -110,6 +110,42 @@ class TestIndexConformance:
         got = index.lookup([_k(1)], set())
         assert got.get(_k(1), []) == ["podA"]
 
+    def test_evict_pod_removes_every_entry(self, index):
+        """Dead-pod sweep parity (ISSUE 3): all keys, all tiers, all models
+        — and keys whose pod set empties disappear entirely."""
+        index.add([_k(1), _k(2)], [_e("podA"), _e("podB")])
+        index.add([_k(3)], [_e("podA", DeviceTier.HOST_DRAM)])
+        index.add([_k(4, "other-model")], [_e("podA")])
+        removed = index.evict_pod("podA")
+        assert removed == 4
+        got = index.lookup([_k(1), _k(2)], set())
+        assert got.get(_k(1), []) == ["podB"]
+        assert got.get(_k(2), []) == ["podB"]
+        # podA-only keys are gone in both models
+        assert index.lookup([_k(3)], set()).get(_k(3), []) == []
+        assert index.lookup([_k(4, "other-model")], set()).get(
+            _k(4, "other-model"), []
+        ) == []
+
+    def test_evict_pod_multi_tier_same_key(self, index):
+        index.add(
+            [_k(1)],
+            [_e("podA", DeviceTier.TPU_HBM), _e("podA", DeviceTier.HOST_DRAM)],
+        )
+        assert index.evict_pod("podA") == 2
+        assert index.lookup([_k(1)], set()).get(_k(1), []) == []
+
+    def test_evict_pod_unknown_is_noop(self, index):
+        index.add([_k(1)], [_e("podA")])
+        assert index.evict_pod("never-seen") == 0
+        assert index.lookup([_k(1)], set())[_k(1)] == ["podA"]
+
+    def test_evict_pod_then_readd_revives(self, index):
+        index.add([_k(1)], [_e("podA")])
+        index.evict_pod("podA")
+        index.add([_k(1)], [_e("podA")])
+        assert index.lookup([_k(1)], set())[_k(1)] == ["podA"]
+
     def test_concurrent_operations(self, index):
         errors = []
         n_threads, n_ops = 20, 25
@@ -119,13 +155,15 @@ class TestIndexConformance:
                 for i in range(n_ops):
                     key = _k(i % 7)
                     pod = f"pod{tid % 3}"
-                    op = (tid + i) % 3
+                    op = (tid + i) % 4
                     if op == 0:
                         index.add([key], [_e(pod)])
                     elif op == 1:
                         index.lookup([key], set())
-                    else:
+                    elif op == 2:
                         index.evict(key, [_e(pod)])
+                    else:  # pod sweeps race normal traffic
+                        index.evict_pod(pod)
             except Exception as exc:  # pragma: no cover
                 errors.append(exc)
 

@@ -19,6 +19,8 @@ from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
     BlockRemoved,
     BlockStored,
     EventBatch,
+    Heartbeat,
+    IndexSnapshot,
     KVEventsPool,
     KVEventsPoolConfig,
     Message,
@@ -98,6 +100,35 @@ class TestEventSchema:
         batch = EventBatch(ts=0.0, events=[BlockStored(block_hashes=[big])])
         decoded = decode_event_batch(batch.to_payload())
         assert decoded.events[0].block_hashes == [big]
+
+    def test_heartbeat_round_trip(self):
+        batch = EventBatch(ts=1.0, events=[Heartbeat(dropped_batches=7)])
+        (ev,) = decode_event_batch(batch.to_payload()).events
+        assert ev == Heartbeat(dropped_batches=7)
+        # bare legacy form: ["Heartbeat"] with no fields
+        (ev,) = decode_event_batch(msgpack.packb([1.0, [["Heartbeat"]]])).events
+        assert ev == Heartbeat(dropped_batches=0)
+
+    def test_index_snapshot_round_trip(self):
+        snap = IndexSnapshot(
+            blocks_by_medium={"tpu_hbm": [1, 2, 2**64 - 1], "host_dram": []}
+        )
+        batch = EventBatch(ts=1.0, events=[snap])
+        (ev,) = decode_event_batch(batch.to_payload()).events
+        assert ev == snap
+
+    def test_malformed_snapshot_skipped(self):
+        cases = [
+            [1.0, [["IndexSnapshot"]]],                       # no digest
+            [1.0, [["IndexSnapshot", ["not", "a", "dict"]]]],
+            [1.0, [["IndexSnapshot", {"tpu_hbm": "not-a-list"}]]],
+            [1.0, [["Heartbeat", "not-an-int"]]],             # tolerated → 0
+        ]
+        for case in cases[:3]:
+            decoded = decode_event_batch(msgpack.packb(case))
+            assert decoded is not None and decoded.events == []
+        (hb,) = decode_event_batch(msgpack.packb(cases[-1])).events
+        assert hb == Heartbeat(dropped_batches=0)
 
 
 class TestFNV:
@@ -447,6 +478,31 @@ class TestDecodeFuzz:
         for case in cases:
             decode_event_batch(msgpack.packb(case))
 
+    def test_snapshot_and_heartbeat_through_pool(self):
+        """Self-healing events flow through the worker pool: a snapshot
+        replaces the pod's view; a heartbeat is a harmless no-op without an
+        attached FleetHealth (legacy pools stay bit-identical)."""
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _stored_payload([1, 2])))
+            snap = EventBatch(
+                ts=0.0,
+                events=[
+                    Heartbeat(),
+                    IndexSnapshot(blocks_by_medium={"tpu_hbm": [2, 3]}),
+                ],
+            ).to_payload()
+            pool.add_task(Message("t", "pod-1", MODEL, snap))
+            assert pool.drain()
+            got = index.lookup([Key(MODEL, h) for h in (1, 2, 3)], set())
+            assert got.get(Key(MODEL, 1), []) == []  # replaced away
+            assert got[Key(MODEL, 2)] == ["pod-1"]
+            assert got[Key(MODEL, 3)] == ["pod-1"]
+        finally:
+            pool.shutdown()
+
     def test_fuzz_through_pool_worker(self):
         """Same robustness at the pool level: garbage tasks never kill the
         worker; a valid task after 200 fuzzed ones still lands."""
@@ -464,5 +520,137 @@ class TestDecodeFuzz:
             assert pool.drain(timeout=30)
             got = index.lookup([Key(MODEL, 99)], set())
             assert got[Key(MODEL, 99)] == ["pod-ok"]
+        finally:
+            pool.shutdown()
+
+
+class TestSubscriberFrameHardening:
+    """ISSUE 3 satellite: malformed messages — wrong frame count, short seq
+    frame, undecodable topic — are counted and dropped; none may kill the
+    receive loop."""
+
+    @staticmethod
+    def _sub():
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        return ZMQSubscriber(pool, ZMQSubscriberConfig()), pool, index
+
+    def test_wrong_frame_count_dropped(self):
+        sub, _, _ = self._sub()
+        assert sub._parse_frames([b"kv@p@m"]) is None
+        assert sub._parse_frames([b"a", b"b", b"c", b"d"]) is None
+        assert sub.malformed_dropped["frames"] == 2
+
+    def test_short_seq_frame_dropped(self):
+        sub, _, _ = self._sub()
+        # Pre-hardening this decoded with seq=0, silently poisoning gap
+        # detection; now it is counted and dropped.
+        assert sub._parse_frames([b"kv@p@m", b"\x00\x01", b"{}"]) is None
+        assert sub._parse_frames([b"kv@p@m", b"\x00" * 9, b"{}"]) is None
+        assert sub.malformed_dropped["seq"] == 2
+
+    def test_undecodable_topic_dropped(self):
+        sub, _, _ = self._sub()
+        assert sub._parse_frames([b"\xff\xfe\xfd", b"\x00" * 8, b"{}"]) is None
+        assert sub.malformed_dropped["topic"] == 1
+
+    def test_unparseable_topic_dropped(self):
+        sub, _, _ = self._sub()
+        assert sub._parse_frames([b"not-kv-topic", b"\x00" * 8, b"{}"]) is None
+        assert sub.malformed_dropped["topic"] == 1
+
+    def test_valid_frames_still_parse(self):
+        sub, _, _ = self._sub()
+        msg = sub._parse_frames(
+            [b"kv@pod-1@" + MODEL.encode(), struct.pack(">Q", 42), b"payload"]
+        )
+        assert msg is not None
+        assert (msg.pod_identifier, msg.model_name, msg.seq) == ("pod-1", MODEL, 42)
+        assert sum(sub.malformed_dropped.values()) == 0
+
+    def test_receive_loop_survives_garbage_frames(self):
+        """Over a real socket: malformed multipart messages precede a valid
+        one; the loop must survive and deliver the valid event."""
+        import zmq
+
+        from conftest import free_tcp_port
+
+        port = free_tcp_port()
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        sub = ZMQSubscriber(pool, ZMQSubscriberConfig(endpoint=f"tcp://*:{port}"))
+        sub.start()
+        try:
+            ctx = zmq.Context.instance()
+            raw = ctx.socket(zmq.PUB)
+            raw.connect(f"tcp://localhost:{port}")
+            topic = f"kv@pod-g@{MODEL}".encode()
+            deadline = time.time() + 20
+            found = {}
+            while time.time() < deadline and not found:
+                raw.send_multipart([topic, b"\x00" * 8])              # 2 frames
+                raw.send_multipart([topic, b"\x01", b"x"])            # short seq
+                raw.send_multipart([b"\xff\xfe", b"\x00" * 8, b"x"])  # bad utf-8... 
+                # (note: SUB topic filter drops the bad-topic one early)
+                raw.send_multipart(
+                    [topic, struct.pack(">Q", 1), _stored_payload([5])]
+                )
+                time.sleep(0.2)
+                found = index.lookup([Key(MODEL, 5)], set())
+            raw.close(linger=0)
+            assert found.get(Key(MODEL, 5)) == ["pod-g"]
+            assert sub.malformed_dropped["frames"] >= 1
+            assert sub.malformed_dropped["seq"] >= 1
+        finally:
+            sub.shutdown()
+            pool.shutdown()
+
+
+class TestPoolShutdownHardening:
+    """ISSUE 3 satellite: shutdown idempotence and drain ordering."""
+
+    def test_double_shutdown_is_idempotent(self):
+        pool = KVEventsPool(InMemoryIndex(), KVEventsPoolConfig(concurrency=2))
+        pool.start()
+        pool.shutdown()
+        pool.shutdown()  # second call must be a no-op
+
+    def test_shutdown_before_start_is_noop(self):
+        pool = KVEventsPool(InMemoryIndex(), KVEventsPoolConfig(concurrency=2))
+        pool.shutdown()
+        pool.start()  # still startable afterwards
+        pool.shutdown()
+
+    def test_shutdown_applies_queued_events_before_join(self):
+        """Events accepted before shutdown land in the index: the poison
+        pill queues BEHIND them, so shutdown drains rather than discards."""
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=2))
+        pool.start()
+        for i in range(200):
+            pool.add_task(Message("t", f"pod-{i % 5}", MODEL, _stored_payload([i])))
+        pool.shutdown()
+        got = index.lookup([Key(MODEL, i) for i in range(200)], set())
+        assert len(got) == 200
+
+    def test_add_task_after_shutdown_rejected_not_parked(self):
+        pool = KVEventsPool(InMemoryIndex(), KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        pool.shutdown()
+        pool.add_task(Message("t", "pod-1", MODEL, _stored_payload([1])))
+        assert pool.rejected_after_shutdown == 1
+        assert pool.drain(timeout=0.5)  # nothing left dangling
+
+    def test_restart_after_shutdown_processes_again(self):
+        index = InMemoryIndex()
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        pool.shutdown()
+        pool.start()
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _stored_payload([9])))
+            assert pool.drain()
+            assert index.lookup([Key(MODEL, 9)], set())[Key(MODEL, 9)] == ["pod-1"]
         finally:
             pool.shutdown()
