@@ -55,9 +55,11 @@ class KVCacheIndexer:
         fleet_health=None,
     ):
         """``fleet_health`` (a ``kvevents.FleetHealth``, optional): when
-        attached, every score map is filtered through its TTL view so a
-        pod past ``pod_ttl_s`` is never returned to the router — even in
-        the window between expiry and the dead-pod sweep landing."""
+        attached, every score map is filtered through its routability view
+        so a pod past ``pod_ttl_s``, one that published a ``PodDrained``
+        goodbye, or one advertising ``draining`` in its heartbeats is
+        never returned to the router — even in the window between expiry
+        and the dead-pod sweep landing."""
         self.config = config or KVCacheIndexerConfig()
         self.fleet_health = fleet_health
         self.token_processor = ChunkedTokenDatabase(self.config.token_processor)
@@ -135,8 +137,10 @@ class KVCacheIndexer:
         return self._lookup_and_score(block_keys, pod_filter)
 
     def _filter_expired(self, scores: dict[str, int]) -> dict[str, int]:
-        """TTL guard: an expired pod must never win routing, even when its
-        swept-in-the-index state lags its expiry (sweeper cadence)."""
+        """Routability guard: an expired, drained, or draining pod must
+        never win routing, even when its swept-in-the-index state lags its
+        expiry (sweeper cadence) or its entries have not been evicted yet
+        (drain still in progress)."""
         if self.fleet_health is None or not scores:
             return scores
         return self.fleet_health.filter_scores(scores)

@@ -6,6 +6,7 @@ from .events import (
     EventBatch,
     Heartbeat,
     IndexSnapshot,
+    PodDrained,
     decode_event_batch,
 )
 from .health import FleetHealth, FleetHealthConfig
@@ -21,6 +22,7 @@ __all__ = [
     "EventBatch",
     "Heartbeat",
     "IndexSnapshot",
+    "PodDrained",
     "decode_event_batch",
     "FleetHealth",
     "FleetHealthConfig",

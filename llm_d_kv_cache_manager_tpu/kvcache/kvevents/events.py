@@ -13,15 +13,21 @@ Self-healing extensions (PR 3; only on the wire when a pod enables the
 heartbeat/resync knobs, so the default wire traffic is bit-identical and
 old subscribers simply skip the unknown tags):
 
-- ``Heartbeat``: ``["Heartbeat", dropped_batches?]`` — liveness beacon;
-  ``dropped_batches`` is the publisher's monotone count of batches dropped
-  after bounded send retries, so the indexer can detect loss even when no
-  later seq reveals the gap (e.g. the dropped batch was the last before
-  idle).
+- ``Heartbeat``: ``["Heartbeat", dropped_batches?, draining?]`` — liveness
+  beacon; ``dropped_batches`` is the publisher's monotone count of batches
+  dropped after bounded send retries, so the indexer can detect loss even
+  when no later seq reveals the gap (e.g. the dropped batch was the last
+  before idle). ``draining`` (PR 4) advertises a pod mid-drain so the
+  scorer stops routing to it before the final goodbye; it is only encoded
+  when true, so heartbeat bytes from a non-draining pod are unchanged.
 - ``IndexSnapshot``: ``["IndexSnapshot", {medium: [block_hashes]}]`` — a
   compact digest of every block the pod currently holds, per tier. The
   ingestion pool applies it as replace-all-for-pod, the reconciliation
   primitive behind sequence-gap repair.
+- ``PodDrained``: ``["PodDrained"]`` (PR 4) — a graceful goodbye: the pod
+  finished draining and its cache is about to vanish. The ingestion pool
+  evicts the pod from the index immediately (no ``POD_TTL_S`` wait) and
+  ``FleetHealth`` marks it drained so the scorer never routes to it.
 
 Decoding is positional and tolerant: trailing optional fields may be absent
 (the reference's "legacy" variants, ``events.go:113-153``) and unknown extra
@@ -41,6 +47,7 @@ BLOCK_REMOVED_TAG = "BlockRemoved"
 ALL_BLOCKS_CLEARED_TAG = "AllBlocksCleared"
 HEARTBEAT_TAG = "Heartbeat"
 INDEX_SNAPSHOT_TAG = "IndexSnapshot"
+POD_DRAINED_TAG = "PodDrained"
 
 
 @dataclass
@@ -83,9 +90,15 @@ class AllBlocksCleared:
 class Heartbeat:
     #: publisher's monotone dropped-batch count (bounded-retry overflow)
     dropped_batches: int = 0
+    #: pod is mid-drain: stop routing to it (encoded only when true so a
+    #: non-draining heartbeat's wire bytes are identical to previous rounds)
+    draining: bool = False
 
     def to_tagged_union(self) -> list[Any]:
-        return [HEARTBEAT_TAG, self.dropped_batches]
+        arr: list[Any] = [HEARTBEAT_TAG, self.dropped_batches]
+        if self.draining:
+            arr.append(True)
+        return arr
 
 
 @dataclass
@@ -99,7 +112,23 @@ class IndexSnapshot:
         return [INDEX_SNAPSHOT_TAG, self.blocks_by_medium]
 
 
-Event = Union[BlockStored, BlockRemoved, AllBlocksCleared, Heartbeat, IndexSnapshot]
+@dataclass
+class PodDrained:
+    """Graceful goodbye: the pod drained and its cache is gone — evict it
+    from the index now rather than waiting out ``POD_TTL_S``."""
+
+    def to_tagged_union(self) -> list[Any]:
+        return [POD_DRAINED_TAG]
+
+
+Event = Union[
+    BlockStored,
+    BlockRemoved,
+    AllBlocksCleared,
+    Heartbeat,
+    IndexSnapshot,
+    PodDrained,
+]
 
 
 @dataclass
@@ -166,7 +195,10 @@ def _decode_event(raw) -> Optional[Event]:
         dropped = _get(fields, 0, 0)
         if not isinstance(dropped, int) or isinstance(dropped, bool):
             dropped = 0
-        return Heartbeat(dropped_batches=dropped)
+        draining = _get(fields, 1, False)
+        if not isinstance(draining, bool):
+            draining = False
+        return Heartbeat(dropped_batches=dropped, draining=draining)
     if tag == INDEX_SNAPSHOT_TAG:
         raw_digest = _get(fields, 0)
         if not isinstance(raw_digest, dict):
@@ -179,6 +211,8 @@ def _decode_event(raw) -> Optional[Event]:
                 return None
             digest[medium] = [int(h) for h in hashes]
         return IndexSnapshot(blocks_by_medium=digest)
+    if tag == POD_DRAINED_TAG:
+        return PodDrained()
     return None  # unknown tag
 
 

@@ -17,6 +17,12 @@ truthful as the event stream. Three failure modes rot it:
 3. **Silent publisher drops** — a dropped batch with no later traffic never
    produces a detectable seq gap. Heartbeats carry the publisher's monotone
    ``dropped_batches`` count, so loss is detected even across idle periods.
+4. **Draining/drained pods** (PR 4) — a pod mid-drain advertises
+   ``draining`` in its heartbeats (routing should stop sending it new
+   prefixes immediately) and publishes a ``PodDrained`` goodbye when the
+   drain completes; the goodbye evicts the pod's entries at once instead of
+   waiting out ``pod_ttl_s`` — a rolling restart must not serve stale
+   locality for a TTL, nor does it need to.
 
 All tracking is observation-only until configured: ``pod_ttl_s=0`` (the
 default) disables expiry/sweeping entirely, and a pool without an attached
@@ -59,6 +65,12 @@ class _PodState:
     swept: bool = False
     #: last publisher-reported dropped_batches count (from Heartbeat)
     reported_drops: int = 0
+    #: pod advertised draining via heartbeat — routable no longer, but its
+    #: entries stay until the PodDrained goodbye (or TTL) evicts them
+    draining: bool = False
+    #: pod published its PodDrained goodbye; treated as expired immediately.
+    #: Clears on any new message (the pod restarted under the same identity).
+    drained: bool = False
 
 
 class FleetHealth:
@@ -81,6 +93,7 @@ class FleetHealth:
         self.pods_swept = 0
         self.heartbeats_seen = 0
         self.publisher_drops_reported = 0
+        self.pods_drained = 0
         self._sweep_thread: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
 
@@ -94,6 +107,14 @@ class FleetHealth:
             st = self._pods.setdefault(pod, _PodState())
             st.last_seen = now
             st.swept = False  # pod is alive again — revive it
+            if st.drained:
+                # Traffic after a PodDrained goodbye = the pod restarted
+                # under the same identity: fully resurrect it (a sticky
+                # draining flag would otherwise unroute the new pod until
+                # its first non-draining heartbeat — forever, when
+                # heartbeats are disabled).
+                st.drained = False
+                st.draining = False
             last = st.last_seq.get(model)
             if last is not None and seq > last + 1:
                 gap = True
@@ -123,14 +144,19 @@ class FleetHealth:
             )
         return gap
 
-    def observe_heartbeat(self, pod: str, dropped_batches: int) -> None:
+    def observe_heartbeat(
+        self, pod: str, dropped_batches: int, draining: bool = False
+    ) -> None:
         """A heartbeat proves liveness and reports the publisher's drop
         count; an increase means batches were lost even if no later seq
-        ever reveals the gap."""
+        ever reveals the gap. ``draining`` advertises a mid-drain pod —
+        the scorer stops returning it immediately (set AND cleared here:
+        heartbeats are the authoritative carrier of drain intent)."""
         with self._mu:
             st = self._pods.setdefault(pod, _PodState())
             st.last_seen = self._clock()
             st.swept = False
+            st.draining = draining
             self.heartbeats_seen += 1
             if dropped_batches < st.reported_drops:
                 # Publisher restart: its drop counter restarted too. Rebase
@@ -163,18 +189,34 @@ class FleetHealth:
         collector.bump("fleet_resyncs")
         collector.fleet_resyncs.inc()
 
+    def observe_drained(self, pod: str) -> None:
+        """A ``PodDrained`` goodbye: the pod finished draining and its
+        entries have been evicted — treat it as expired immediately (no
+        ``pod_ttl_s`` wait) until it is heard from again."""
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.last_seen = self._clock()
+            st.drained = True
+            st.draining = False  # the drain completed; drained supersedes
+            st.suspect = False  # its view is now empty, nothing to repair
+            self.pods_drained += 1
+        collector.bump("fleet_pods_drained")
+        collector.fleet_pods_drained.inc()
+        log.warning("pod drained; evicted from routing immediately", pod=pod)
+
     # -- read-side queries ---------------------------------------------------
     def is_expired(self, pod: str) -> bool:
-        """True when the pod passed its TTL (or was swept) and has not been
-        heard from since. Unknown pods are NOT expired: entries may predate
-        this monitor's attachment, and expiring them would break the
-        observation-only default."""
+        """True when the pod passed its TTL (or was swept, or said its
+        ``PodDrained`` goodbye) and has not been heard from since. Unknown
+        pods are NOT expired: entries may predate this monitor's
+        attachment, and expiring them would break the observation-only
+        default."""
         ttl = self.config.pod_ttl_s
         with self._mu:
             st = self._pods.get(pod)
             if st is None:
                 return False
-            if st.swept:
+            if st.swept or st.drained:
                 return True
             if ttl <= 0:
                 return False
@@ -185,12 +227,36 @@ class FleetHealth:
             st = self._pods.get(pod)
             return bool(st and st.suspect)
 
+    def is_draining(self, pod: str) -> bool:
+        with self._mu:
+            st = self._pods.get(pod)
+            return bool(st and (st.draining or st.drained))
+
+    def is_routable(self, pod: str) -> bool:
+        """Should routing consider this pod at all? Excludes expired pods
+        (TTL/swept/drained) and pods advertising a drain in progress —
+        sending a new prefix to a pod that will evict it in seconds just
+        burns the transfer and the client's retry. One lock acquisition
+        (not is_expired + is_draining): this runs per pod on the scoring
+        hot path, contended with the ingestion workers."""
+        ttl = self.config.pod_ttl_s
+        with self._mu:
+            st = self._pods.get(pod)
+            if st is None:
+                return True  # unknown pods stay routable (observation-only)
+            if st.swept or st.drained or st.draining:
+                return False
+            if ttl <= 0:
+                return True
+            return (self._clock() - st.last_seen) <= ttl
+
     def filter_scores(self, scores: dict[str, int]) -> dict[str, int]:
-        """Drop expired pods from a score map — the guarantee that routing
-        never targets a pod past its TTL, even before the sweeper lands."""
+        """Drop expired and draining pods from a score map — the guarantee
+        that routing never targets a pod past its TTL (even before the
+        sweeper lands) nor one that advertised a drain in progress."""
         if not scores:
             return scores
-        out = {p: s for p, s in scores.items() if not self.is_expired(p)}
+        out = {p: s for p, s in scores.items() if self.is_routable(p)}
         return out if len(out) != len(scores) else scores
 
     def snapshot(self) -> dict:
@@ -200,6 +266,8 @@ class FleetHealth:
                 pod: {
                     "suspect": st.suspect,
                     "swept": st.swept,
+                    "draining": st.draining,
+                    "drained": st.drained,
                     "age_s": round(self._clock() - st.last_seen, 3),
                 }
                 for pod, st in self._pods.items()
@@ -211,6 +279,7 @@ class FleetHealth:
             "pods_swept": self.pods_swept,
             "heartbeats_seen": self.heartbeats_seen,
             "publisher_drops_reported": self.publisher_drops_reported,
+            "pods_drained": self.pods_drained,
             "pods": pods,
         }
 

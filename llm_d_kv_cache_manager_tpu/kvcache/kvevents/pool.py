@@ -26,6 +26,7 @@ from .events import (
     BlockStored,
     Heartbeat,
     IndexSnapshot,
+    PodDrained,
     decode_event_batch,
 )
 
@@ -205,10 +206,26 @@ class KVEventsPool:
             elif isinstance(ev, Heartbeat):
                 if self.health is not None:
                     self.health.observe_heartbeat(
-                        msg.pod_identifier, ev.dropped_batches
+                        msg.pod_identifier, ev.dropped_batches, ev.draining
                     )
             elif isinstance(ev, IndexSnapshot):
                 self._apply_snapshot(msg, ev)
+            elif isinstance(ev, PodDrained):
+                # Graceful goodbye: evict the pod NOW — a drained pod's
+                # cache is gone and a rolling restart must not serve stale
+                # locality for a whole POD_TTL_S. Eviction is unconditional
+                # (no health needed): the pod itself declared the state.
+                try:
+                    self.index.evict_pod(msg.pod_identifier)
+                except Exception:
+                    log.exception(
+                        "drained-pod eviction failed", pod=msg.pod_identifier
+                    )
+                if self.health is not None:
+                    self.health.observe_drained(msg.pod_identifier)
+                log.info(
+                    "pod drained; evicted from index", pod=msg.pod_identifier
+                )
             elif isinstance(ev, AllBlocksCleared):
                 # No-op, as in the reference (pool.go:300-301): the event
                 # carries no hash list, and the index ages entries out.

@@ -58,6 +58,12 @@ fleet_pods_swept = _NullMetric()
 fleet_publisher_drops = _NullMetric()
 breaker_opens = _NullMetric()
 breaker_closes = _NullMetric()
+# Request-lifecycle robustness (PR 4): pods that said a PodDrained goodbye
+# (evicted without a TTL wait), scoring requests degraded to an empty
+# scoreboard because the index backend failed (routing falls back to cold
+# placement instead of erroring the request).
+fleet_pods_drained = _NullMetric()
+scorer_errors = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -71,6 +77,8 @@ _shadow = {
     "fleet_publisher_drops": 0,
     "breaker_opens": 0,
     "breaker_closes": 0,
+    "fleet_pods_drained": 0,
+    "scorer_errors": 0,
 }
 _shadow_lock = threading.Lock()
 
@@ -89,7 +97,7 @@ def register(registry=None) -> None:
     """Idempotently create and register the collectors."""
     global _registered, admissions, evictions, lookup_requests, lookup_hits, lookup_latency
     global fleet_gaps, fleet_resyncs, fleet_pods_swept, fleet_publisher_drops
-    global breaker_opens, breaker_closes
+    global breaker_opens, breaker_closes, fleet_pods_drained, scorer_errors
     with _lock:
         if _registered:
             return
@@ -151,6 +159,17 @@ def register(registry=None) -> None:
         breaker_closes = _prom.Counter(
             "kvcache_transfer_breaker_closes_total",
             "Transfer circuit-breaker close transitions (half-open probe ok)",
+            registry=registry,
+        )
+        fleet_pods_drained = _prom.Counter(
+            "kvcache_fleet_pods_drained_total",
+            "Pods evicted immediately after a PodDrained goodbye",
+            registry=registry,
+        )
+        scorer_errors = _prom.Counter(
+            "kvcache_scorer_errors_total",
+            "Scoring requests degraded to an empty scoreboard because the "
+            "index backend failed",
             registry=registry,
         )
         _registered = True

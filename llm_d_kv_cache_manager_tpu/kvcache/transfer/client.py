@@ -199,12 +199,15 @@ class KVTransferClient:
         model_name: str,
         block_hashes: Sequence[int],
         max_blocks: Optional[int] = None,
+        timeout_s: Optional[float] = None,
     ) -> tuple[list[BlockPayload], bool]:
         """Fetch the longest resident prefix of ``block_hashes`` from the
         peer. Returns ``(blocks, complete)``; raises ``TransferError`` on
         timeout/service failure (callers fall back to cold prefill). With
         a tripped breaker the error is raised immediately — no socket I/O,
-        no timeout wait."""
+        no timeout wait. ``timeout_s`` overrides the configured poll
+        deadline for this call — the hook request-deadline callers use to
+        clamp a pull to the request's remaining budget."""
         if not block_hashes:
             return [], True
         if self.breaker is not None and not self.breaker.allow():
@@ -214,7 +217,9 @@ class KVTransferClient:
                 f"(skipping fetch; cold prefill)"
             )
         try:
-            blocks, complete = self._fetch_once(model_name, block_hashes, max_blocks)
+            blocks, complete = self._fetch_once(
+                model_name, block_hashes, max_blocks, timeout_s
+            )
         except Exception:
             # Any failure settles the breaker (a stuck half-open probe
             # would otherwise reject every later fetch forever).
@@ -230,9 +235,11 @@ class KVTransferClient:
         model_name: str,
         block_hashes: Sequence[int],
         max_blocks: Optional[int],
+        timeout_s: Optional[float] = None,
     ) -> tuple[list[BlockPayload], bool]:
         import zmq
 
+        deadline_s = self.config.timeout_s if timeout_s is None else timeout_s
         with self._mu:
             if self._closed:
                 raise TransferError("client closed")
@@ -240,10 +247,10 @@ class KVTransferClient:
             t0 = time.perf_counter()
             try:
                 sock.send(encode_request(model_name, block_hashes, max_blocks))
-                if not sock.poll(int(self.config.timeout_s * 1000), zmq.POLLIN):
+                if not sock.poll(int(deadline_s * 1000), zmq.POLLIN):
                     self._reset_socket()  # a late reply must not leak forward
                     raise TransferError(
-                        f"fetch timed out after {self.config.timeout_s}s "
+                        f"fetch timed out after {deadline_s}s "
                         f"({self.config.endpoint})"
                     )
                 frames = sock.recv_multipart()

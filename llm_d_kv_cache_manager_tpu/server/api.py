@@ -30,6 +30,7 @@ from aiohttp import web
 
 from ..kvcache import KVCacheIndexer, KVCacheIndexerConfig
 from ..kvcache.kvblock import TokenProcessorConfig
+from ..kvcache.metrics import collector
 from ..kvcache.kvevents import (
     FleetHealth,
     FleetHealthConfig,
@@ -186,8 +187,14 @@ class ScoringService:
                 None, self.indexer.get_pod_scores, prompt, model, pods
             )
         except Exception as exc:
-            log.exception("scoring failed")
-            return web.json_response({"error": str(exc)}, status=500)
+            # Index backend down (e.g. Redis unreachable): degrade to an
+            # empty scoreboard — the router falls back to a cold placement
+            # and the REQUEST still serves, just without cache affinity. A
+            # 500 here would turn an index outage into a serving outage.
+            log.exception("scoring failed; degrading to empty scoreboard")
+            collector.bump("scorer_errors")
+            collector.scorer_errors.inc()
+            return web.json_response({"scores": {}, "degraded": str(exc)})
         return web.json_response({"scores": scores})
 
     async def handle_score_chat_completions(self, request: web.Request) -> web.Response:
@@ -204,7 +211,7 @@ class ScoringService:
             )
         loop = asyncio.get_running_loop()
 
-        def render_and_score():
+        def render():
             template, template_vars = self.chat.fetch_chat_template(
                 FetchTemplateRequest(
                     model=model,
@@ -222,17 +229,33 @@ class ScoringService:
                     template_vars=template_vars,
                 )
             )
-            prompt = rendered.rendered_chats[0]
-            scores = self.indexer.get_pod_scores(
-                prompt, model, body.get("pod_identifiers") or []
-            )
-            return prompt, scores
+            return rendered.rendered_chats[0]
 
+        # Template fetch/render failures are deterministic request problems
+        # (malformed messages, bad chat_template) — a 400 the client can
+        # act on, NOT a degradation: masking them as empty scores would
+        # cold-place the broken request forever and pollute the
+        # scorer-error counter that alerts on index outages.
         try:
-            prompt, scores = await loop.run_in_executor(None, render_and_score)
+            prompt = await loop.run_in_executor(None, render)
         except Exception as exc:
-            log.exception("chat scoring failed")
-            return web.json_response({"error": str(exc)}, status=500)
+            log.exception("chat template render failed")
+            return web.json_response({"error": str(exc)}, status=400)
+        try:
+            scores = await loop.run_in_executor(
+                None,
+                self.indexer.get_pod_scores,
+                prompt,
+                model,
+                body.get("pod_identifiers") or [],
+            )
+        except Exception as exc:
+            # Index backend down: same degradation contract as
+            # /score_completions — cost cache affinity, not the request.
+            log.exception("chat scoring failed; degrading to empty scoreboard")
+            collector.bump("scorer_errors")
+            collector.scorer_errors.inc()
+            return web.json_response({"scores": {}, "degraded": str(exc)})
         return web.json_response({"scores": scores, "rendered_prompt_chars": len(prompt)})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:

@@ -34,7 +34,7 @@ from ..utils import get_logger
 from .block_manager import AllocationError, BlockManager, BlockManagerConfig
 from ..ops.sampling import sample_tokens
 from .scheduler import Scheduler, SchedulerConfig
-from .sequence import SamplingParams, Sequence
+from .sequence import SamplingParams, Sequence, SequenceStatus
 
 log = get_logger("server.engine")
 
@@ -370,6 +370,15 @@ class Engine:
         self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self.finished: list[Sequence] = []
         self._step_count = 0
+        #: set once any request carries a deadline — gates the per-step
+        #: expiry scan so the no-deadline path stays bit-identical legacy.
+        self._deadlines_used = False
+        #: request-lifecycle observability (deadline sheds/expiries, aborts)
+        self.lifecycle_stats = {
+            "deadline_shed": 0,
+            "deadline_expired": 0,
+            "aborted": 0,
+        }
         #: in-flight fused decode burst (decode_pipeline): toks device
         #: array, lane-ordered active list, and the np position/len arrays
         #: the NEXT burst derives from.
@@ -643,7 +652,12 @@ class Engine:
         prompt_tokens: list[int],
         sampling: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> Sequence:
+        """``deadline``: absolute ``time.monotonic()`` deadline. Expired
+        waiting sequences are shed before prefill; running sequences past
+        it finish early with ``finish_reason="deadline"``. None (default)
+        = no deadline, bit-identical legacy behavior."""
         if len(prompt_tokens) == 0:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.config.max_model_len:
@@ -660,9 +674,87 @@ class Engine:
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
             request_id=request_id,
+            deadline=deadline,
         )
+        if deadline is not None:
+            self._deadlines_used = True
         self.scheduler.add(seq)
         return seq
+
+    def abort(self, request_id: str) -> Optional[Sequence]:
+        """Abort a request mid-flight — client disconnect, generate()
+        timeout, operator action — releasing its pages/slots immediately
+        instead of decoding into the void. Finds the sequence in whichever
+        scheduler state holds it (waiting, mid-prefill, running), removes
+        it, frees its pages, and marks it FINISHED with
+        ``finish_reason="abort"``. Returns the aborted sequence, or None
+        when no live sequence carries ``request_id`` (already finished, or
+        never admitted). Must run on the engine thread (page-pool
+        ownership rule — the serving layer stages aborts onto the loop)."""
+        seq = None
+        for cand in (
+            list(self.scheduler.waiting)
+            + self.scheduler.prefilling
+            + self.scheduler.running
+        ):
+            if cand.request_id == request_id:
+                seq = cand
+                break
+        if seq is None:
+            return None
+        # An in-flight pipelined burst may hold this lane on device: commit
+        # it first so batchmates keep their tokens and the lane set the
+        # next dispatch sees matches scheduler state.
+        if self._inflight is not None and any(
+            s is seq for s in self._inflight["active"]
+        ):
+            self._drain_inflight()
+        if seq in self.scheduler.waiting:
+            self.scheduler.waiting.remove(seq)
+        else:
+            self.scheduler.on_preempted(seq)  # removes from running/prefilling
+        self.block_manager.free_sequence(seq)
+        seq.status = SequenceStatus.FINISHED
+        seq.finish_reason = "abort"
+        seq.finish_time = time.monotonic()
+        self.lifecycle_stats["aborted"] += 1
+        self.finished.append(seq)
+        # Ship any pending BlockStored/BlockRemoved now: an idle engine may
+        # not step again for a while, and the index must not hold stale
+        # state for pages this abort just released.
+        self.block_manager.flush_events()
+        log.warning(
+            "aborted request; pages released",
+            request=request_id,
+            seq=seq.seq_id,
+            generated=seq.num_generated,
+        )
+        return seq
+
+    def abort_all(self) -> list[Sequence]:
+        """Abort every live sequence (the drain-timeout hammer): commits
+        any in-flight burst, then releases all pages. Engine thread only."""
+        self._drain_inflight()
+        out: list[Sequence] = []
+        for seq in (
+            list(self.scheduler.waiting)
+            + list(self.scheduler.prefilling)
+            + list(self.scheduler.running)
+        ):
+            self.scheduler.on_preempted(seq)  # removes from running/prefilling
+            if seq in self.scheduler.waiting:
+                self.scheduler.waiting.remove(seq)
+            self.block_manager.free_sequence(seq)
+            seq.status = SequenceStatus.FINISHED
+            seq.finish_reason = "abort"
+            seq.finish_time = time.monotonic()
+            self.lifecycle_stats["aborted"] += 1
+            self.finished.append(seq)
+            out.append(seq)
+        if out:
+            self.block_manager.flush_events()
+            log.warning("aborted all live requests", count=len(out))
+        return out
 
     @property
     def has_work(self) -> bool:
@@ -676,6 +768,17 @@ class Engine:
         step — a budgeted chunk batch *and* every running decode lane —
         and both dispatch in the same iteration, so a long prompt's ingest
         never stalls running decodes for more than one chunk's compute."""
+        shed: list[Sequence] = []
+        if self._deadlines_used:
+            # Deadline shedding BEFORE scheduling: an expired waiting seq
+            # must never reach prefill, and an expired mid-prefill seq
+            # releases its pages for work that can still meet its SLO.
+            now = time.monotonic()
+            shed = self.scheduler.shed_expired(now)
+            for seq in shed:
+                seq.finish_time = now
+                self.lifecycle_stats["deadline_shed"] += 1
+                self.finished.append(seq)
         out = self.scheduler.schedule()
         if out.prefill:
             # Prefill must see committed decode state (page accounting,
@@ -692,7 +795,7 @@ class Engine:
         elif not out.prefill:
             self._drain_inflight()
 
-        newly_finished = []
+        newly_finished = list(shed)
         for seq in list(self.scheduler.running):
             if self._should_finish(seq):
                 seq.finish_time = time.monotonic()
@@ -719,6 +822,14 @@ class Engine:
         if seq.num_generated >= seq.sampling.max_new_tokens:
             return True
         if seq.all_tokens[-1] in seq.sampling.stop_token_ids:
+            return True
+        if seq.deadline is not None and time.monotonic() >= seq.deadline:
+            # Past-deadline running lane: finish with what it has — the
+            # client's SLO is blown either way, so stop burning pages and
+            # decode lanes on tokens nobody will wait for.
+            if seq.finish_reason is None:
+                seq.finish_reason = "deadline"
+                self.lifecycle_stats["deadline_expired"] += 1
             return True
         return seq.num_tokens >= self.config.max_model_len
 

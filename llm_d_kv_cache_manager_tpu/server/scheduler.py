@@ -84,6 +84,45 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.prefilling or self.running)
 
+    def shed_expired(self, now: float) -> list[Sequence]:
+        """Deadline shedding for requests that have not produced a token
+        yet: expired WAITING sequences are dropped before any prefill
+        compute is spent on them, and expired MID-PREFILL sequences (their
+        chunked ingest cannot beat an already-passed deadline) release
+        their pages. Running lanes are not touched here — the engine
+        finishes them at the next commit point so partial output is still
+        returned. Shed sequences are marked FINISHED with
+        ``finish_reason="deadline"``; the caller (engine step) reports
+        them as finished so the serving layer resolves their futures.
+        Only called when at least one live request carries a deadline, so
+        the legacy no-deadline path never pays the scan."""
+        shed: list[Sequence] = []
+        if any(
+            s.deadline is not None and now >= s.deadline for s in self.waiting
+        ):
+            keep: deque[Sequence] = deque()
+            for seq in self.waiting:
+                if seq.deadline is not None and now >= seq.deadline:
+                    shed.append(seq)
+                else:
+                    keep.append(seq)
+            self.waiting = keep
+        for seq in list(self.prefilling):
+            if seq.deadline is not None and now >= seq.deadline:
+                self.prefilling.remove(seq)
+                self.block_manager.free_sequence(seq)
+                seq.reset_allocation()
+                shed.append(seq)
+        for seq in shed:
+            seq.status = SequenceStatus.FINISHED
+            seq.finish_reason = "deadline"
+            log.warning(
+                "shedding deadline-expired request before prefill",
+                seq=seq.seq_id,
+                request=seq.request_id,
+            )
+        return shed
+
     def schedule(self) -> ScheduleOutput:
         """Pick the work for one engine step."""
         if self.config.chunked_prefill_tokens is not None:

@@ -57,6 +57,15 @@ class Sequence:
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    #: absolute monotonic deadline (``time.monotonic()`` scale). None
+    #: (default) = no deadline — bit-identical legacy behavior. An expired
+    #: waiting sequence is shed before prefill; an expired running sequence
+    #: finishes at the next commit point with ``finish_reason="deadline"``.
+    deadline: Optional[float] = None
+    #: why the request ended early, when not a normal stop/length finish:
+    #: "deadline" (expired) or "abort" (client gone / operator abort).
+    #: None = the normal finish reasons apply.
+    finish_reason: Optional[str] = None
     #: set when the engine had to abort the request (e.g. unschedulable)
     error: Optional[str] = None
     #: speculative-decode acceptance history (drives the engine's adaptive

@@ -30,6 +30,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 import uuid
 from collections import deque
 from concurrent.futures import Future
@@ -37,7 +38,13 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..kvcache.kvevents import Heartbeat, IndexSnapshot, ZMQPublisher, ZMQPublisherConfig
+from ..kvcache.kvevents import (
+    Heartbeat,
+    IndexSnapshot,
+    PodDrained,
+    ZMQPublisher,
+    ZMQPublisherConfig,
+)
 from ..kvcache.transfer import (
     KVTransferClient,
     KVTransferService,
@@ -54,12 +61,33 @@ from .sequence import SamplingParams, Sequence
 log = get_logger("server.serve")
 
 
+class AdmissionError(RuntimeError):
+    """Request rejected by admission control (the pod is overloaded).
+    Carries a ``retry_after_s`` hint derived from the measured serving
+    rates — the HTTP surface turns it into ``429`` + ``Retry-After``."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DrainingError(RuntimeError):
+    """Request rejected (or terminated) because the pod is draining for a
+    rolling restart — clients should retry against another pod (503)."""
+
+
 class _ServingMetrics:
     """Prometheus serving metrics (the pod-side analogue of the indexer's
     collector): request/token counters, prefix-cache savings, TTFT histogram.
     Inert when prometheus_client is unavailable."""
 
     def __init__(self):
+        # Measured serving rates (EMAs over request completions), kept
+        # OUTSIDE the prometheus guard: admission control derives its
+        # Retry-After hint from them, with or without prometheus_client.
+        self.request_rate: Optional[float] = None  # finished requests / s
+        self.token_rate: Optional[float] = None  # generated tokens / s
+        self._last_finish: Optional[float] = None
         try:
             import prometheus_client as prom
         except ImportError:  # pragma: no cover
@@ -112,6 +140,84 @@ class _ServingMetrics:
         self._spec_seen = {
             "proposed": 0, "accepted": 0, "verify_steps": 0, "bursts": 0,
         }
+        # Overload protection / request lifecycle (PR 4): admission sheds,
+        # deadline expiries, aborts, drain activity.
+        self.admission_rejected = prom.Counter(
+            "kvcache_admission_rejected_total",
+            "Requests rejected by admission control (429)",
+            registry=self.registry,
+        )
+        self.admission_rejected_draining = prom.Counter(
+            "kvcache_admission_draining_rejected_total",
+            "Requests rejected because the pod was draining (503)",
+            registry=self.registry,
+        )
+        self.deadline_shed = prom.Counter(
+            "kvcache_admission_deadline_shed_total",
+            "Deadline-expired requests shed before any prefill compute",
+            registry=self.registry,
+        )
+        self.deadline_expired = prom.Counter(
+            "kvcache_admission_deadline_expired_total",
+            "Running requests finished early at their deadline",
+            registry=self.registry,
+        )
+        self.requests_aborted = prom.Counter(
+            "kvcache_admission_aborted_total",
+            "Requests aborted mid-flight (client disconnect/timeout)",
+            registry=self.registry,
+        )
+        self.drain_started = prom.Counter(
+            "kvcache_drain_started_total",
+            "Graceful drains started (SIGTERM / POST /drain)",
+            registry=self.registry,
+        )
+        self.drain_completed = prom.Counter(
+            "kvcache_drain_completed_total",
+            "Graceful drains completed with every inflight request finished",
+            registry=self.registry,
+        )
+        self.drain_forced = prom.Counter(
+            "kvcache_drain_forced_requests_total",
+            "Inflight requests aborted because the drain timeout expired",
+            registry=self.registry,
+        )
+        self._lifecycle_seen = {
+            "deadline_shed": 0, "deadline_expired": 0, "aborted": 0,
+        }
+
+    def sync_lifecycle_stats(self, stats: dict) -> None:
+        """Mirror the engine's monotone lifecycle counters (deadline sheds/
+        expiries, aborts) into Prometheus."""
+        if self._prom is None:
+            return
+        for key, counter in (
+            ("deadline_shed", self.deadline_shed),
+            ("deadline_expired", self.deadline_expired),
+            ("aborted", self.requests_aborted),
+        ):
+            delta = stats.get(key, 0) - self._lifecycle_seen[key]
+            if delta > 0:
+                counter.inc(delta)
+                self._lifecycle_seen[key] = stats[key]
+
+    def observe_rejected(self, draining: bool) -> None:
+        if self._prom is None:
+            return
+        if draining:
+            self.admission_rejected_draining.inc()
+        else:
+            self.admission_rejected.inc()
+
+    def observe_drain(self, event: str, amount: int = 1) -> None:
+        if self._prom is None:
+            return
+        counter = {
+            "started": self.drain_started,
+            "completed": self.drain_completed,
+            "forced": self.drain_forced,
+        }[event]
+        counter.inc(amount)
 
     def sync_spec_stats(self, stats: dict) -> None:
         """Mirror the engine's monotone spec counters into Prometheus."""
@@ -129,6 +235,26 @@ class _ServingMetrics:
                 self._spec_seen[key] = stats[key]
 
     def observe_finished(self, seq: Sequence) -> None:
+        # Rate EMAs first (prometheus-independent): only requests that
+        # produced tokens feed them — a shed/aborted request finishing
+        # instantly would wildly overstate sustainable throughput.
+        if seq.num_generated > 0:
+            now = time.monotonic()
+            if self._last_finish is not None:
+                dt = max(now - self._last_finish, 1e-3)
+                alpha = 0.3
+                inst_r, inst_t = 1.0 / dt, seq.num_generated / dt
+                self.request_rate = (
+                    inst_r
+                    if self.request_rate is None
+                    else (1 - alpha) * self.request_rate + alpha * inst_r
+                )
+                self.token_rate = (
+                    inst_t
+                    if self.token_rate is None
+                    else (1 - alpha) * self.token_rate + alpha * inst_t
+                )
+            self._last_finish = now
         if self._prom is None:
             return
         self.requests.inc()
@@ -185,6 +311,24 @@ class PodServerConfig:
     #: first OPEN backoff; doubles per failed half-open probe (capped).
     transfer_breaker_backoff_s: float = 1.0
     transfer_breaker_backoff_max_s: float = 30.0
+    # -- overload protection / request lifecycle (all off by default = ----
+    # -- bit-identical legacy behavior) ------------------------------------
+    #: admission control: max requests queued ahead of the engine (staged +
+    #: scheduler waiting). Above it ``submit`` fails fast with 429 +
+    #: ``Retry-After`` instead of queueing unboundedly. 0 = unbounded.
+    admission_max_waiting: int = 0
+    #: admission control: cap on outstanding admitted prompt tokens (a
+    #: conservative proxy for queued prefill work — it includes requests
+    #: currently in compute). 0 = unbounded.
+    admission_max_queued_tokens: int = 0
+    #: default per-request deadline in seconds when the client sends no
+    #: ``X-Request-Deadline`` header. Expired waiting requests are shed
+    #: before prefill; running requests finish early with
+    #: ``finish_reason="deadline"``. 0 = no deadline.
+    default_deadline_s: float = 0.0
+    #: graceful drain: how long inflight requests get to finish after
+    #: SIGTERM / ``POST /drain`` before being aborted.
+    drain_timeout_s: float = 30.0
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -226,6 +370,21 @@ class PodServerConfig:
             os.environ.get(
                 "TRANSFER_BREAKER_BACKOFF_MAX_S", cfg.transfer_breaker_backoff_max_s
             )
+        )
+        # Overload protection / request lifecycle (0/unset = off, legacy).
+        cfg.admission_max_waiting = int(
+            os.environ.get("ADMISSION_MAX_WAITING", cfg.admission_max_waiting)
+        )
+        cfg.admission_max_queued_tokens = int(
+            os.environ.get(
+                "ADMISSION_MAX_QUEUED_TOKENS", cfg.admission_max_queued_tokens
+            )
+        )
+        cfg.default_deadline_s = float(
+            os.environ.get("REQUEST_DEADLINE_S", cfg.default_deadline_s)
+        )
+        cfg.drain_timeout_s = float(
+            os.environ.get("DRAIN_TIMEOUT_S", cfg.drain_timeout_s)
         )
 
         eng = cfg.engine
@@ -329,8 +488,25 @@ class PodServer:
         #: without any lock and enqueueing never waits on device compute.
         self._mu = threading.Lock()
         self._work = threading.Condition(self._mu)
-        self._staging: deque[tuple[list[int], Optional[SamplingParams], Future]] = deque()
+        #: staged request tuples: (tokens, sampling, deadline, rid, future)
+        self._staging: deque[
+            tuple[list[int], Optional[SamplingParams], Optional[float], str, Future]
+        ] = deque()
         self._futures: dict[int, Future] = {}  # loop-thread-only
+        #: staged aborts: (request_id | None = all, future -> bool)
+        self._aborts: deque[tuple[Optional[str], Future]] = deque()
+        #: admission accounting (under _mu): requests admitted by submit
+        #: whose futures have not resolved yet, and their prompt tokens.
+        self._pending = 0
+        self._pending_tokens = 0
+        self.admission_rejected = 0
+        self.admission_rejected_draining = 0
+        #: graceful drain state
+        self._draining = False
+        self._drain_done = threading.Event()
+        self._drain_clean: Optional[bool] = None
+        self.drains_started = 0
+        self.drain_forced_requests = 0
         self.metrics = _ServingMetrics()
         self._running = False
         self._failed: Optional[str] = None
@@ -387,6 +563,84 @@ class PodServer:
             )
             self._self_heal_thread.start()
 
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful drain for rolling restarts. Flips the pod to draining
+        (new submits raise ``DrainingError`` → 503; ``/healthz`` turns 503
+        so k8s readiness agrees; heartbeats advertise ``draining`` so the
+        scorer stops picking this pod immediately), lets inflight requests
+        finish for up to ``drain_timeout_s``, aborts whatever is left
+        (their futures resolve with the partial sequence,
+        ``finish_reason="abort"``), then publishes a final
+        ``IndexSnapshot`` plus the ``PodDrained`` goodbye — the fleet
+        evicts this pod's entries at once instead of waiting out
+        ``POD_TTL_S``. The engine loop stays up so ``/stats`` remains
+        queryable until the process exits (``shutdown`` still applies).
+        Idempotent: concurrent calls wait for the first drain. Returns
+        True when every inflight request finished within the budget."""
+        with self._work:
+            first = not self._draining
+            if first:
+                self._draining = True
+                self.drains_started += 1
+        if not first:
+            self._drain_done.wait()
+            return bool(self._drain_clean)
+        self.metrics.observe_drain("started")
+        log.warning(
+            "drain started",
+            pod=self.config.pod_identifier,
+            timeout_s=timeout_s or self.config.drain_timeout_s,
+        )
+        # Advertise NOW, not at the next heartbeat tick: every second of
+        # stale routing sends this pod prefixes it is about to evict.
+        if self.config.heartbeat_interval_s > 0:
+            self._publish_heartbeat()
+        budget = self.config.drain_timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            with self._mu:
+                if self._pending == 0:
+                    break
+            time.sleep(0.02)
+        with self._mu:
+            leftover = self._pending
+        clean = leftover == 0
+        if not clean:
+            # Wedged clients / runaway generations past the budget: abort
+            # them (pages released, futures resolve with partial output)
+            # rather than holding the rolling restart hostage.
+            self.drain_forced_requests += leftover
+            self.metrics.observe_drain("forced", leftover)
+            log.error(
+                "drain timeout; aborting inflight requests",
+                leftover=leftover,
+                timeout_s=budget,
+            )
+            try:
+                self.abort(None).result(timeout=30)
+            except Exception:
+                log.exception("drain abort-all failed")
+        # Final goodbye, ordered: the snapshot (engine-loop read, so it
+        # reflects post-abort truth) lands before PodDrained evicts the
+        # pod — consumers without PodDrained support still get a truthful
+        # final view instead of a stale one.
+        if self._publisher is not None:
+            self.publish_index_snapshot(timeout_s=30.0, wait=True)
+            try:
+                self._publisher.publish([PodDrained()])
+            except Exception:
+                log.exception("PodDrained publish failed")
+        self._drain_clean = clean
+        if clean:
+            self.metrics.observe_drain("completed")
+        self._drain_done.set()
+        log.warning("drain complete", pod=self.config.pod_identifier, clean=clean)
+        return clean
+
+    @property
+    def is_draining(self) -> bool:
+        return self._draining
+
     def shutdown(self) -> None:
         self._self_heal_stop.set()
         if self._self_heal_thread is not None:
@@ -413,6 +667,8 @@ class PodServer:
         with self._mu:
             staged = list(self._staging)
             self._staging.clear()
+            aborts = list(self._aborts)
+            self._aborts.clear()
             transfers = (
                 list(self._transfer_exports)
                 + list(self._transfer_imports)
@@ -421,9 +677,14 @@ class PodServer:
             self._transfer_exports.clear()
             self._transfer_imports.clear()
             self._digest_requests.clear()
-        for _, _, fut in staged:
+            self._pending = 0
+            self._pending_tokens = 0
+        for _, _, _, _, fut in staged:
             if not fut.done():
                 fut.set_exception(exc)
+        for _, afut in aborts:
+            if not afut.done():
+                afut.set_result(False)  # nothing left alive to abort
         for item in transfers:
             fut = item[-1]
             if not fut.done():
@@ -433,12 +694,29 @@ class PodServer:
                 fut.set_exception(exc)
         self._futures.clear()
 
+    def _forget_pending(self, n_tokens: int) -> None:
+        """Release one request's admission accounting (engine loop only)."""
+        with self._mu:
+            self._pending = max(self._pending - 1, 0)
+            self._pending_tokens = max(self._pending_tokens - n_tokens, 0)
+
+    def _resolve(self, seq: Sequence) -> None:
+        """Resolve a finished/aborted sequence's future and release its
+        admission accounting (engine loop only)."""
+        self.metrics.observe_finished(seq)
+        fut = self._futures.pop(seq.seq_id, None)
+        if fut is not None:
+            self._forget_pending(seq.user_prompt_len)
+            if not fut.done():
+                fut.set_result(seq)
+
     def _engine_loop(self) -> None:
         try:
             while True:
                 with self._work:
                     while self._running and not (
                         self._staging
+                        or self._aborts
                         or self._transfer_exports
                         or self._transfer_imports
                         or self._digest_requests
@@ -449,6 +727,8 @@ class PodServer:
                         return
                     staged = list(self._staging)
                     self._staging.clear()
+                    aborts = list(self._aborts)
+                    self._aborts.clear()
                     exports = list(self._transfer_exports)
                     self._transfer_exports.clear()
                     imports = list(self._transfer_imports)
@@ -476,15 +756,42 @@ class PodServer:
                         )
                     except Exception as e:
                         fut.set_exception(e)
-                for tokens, sampling, fut in staged:
+                for tokens, sampling, deadline, rid, fut in staged:
                     try:
                         seq = self.engine.add_request(
-                            tokens, sampling, request_id=str(uuid.uuid4())
+                            tokens, sampling, request_id=rid, deadline=deadline
                         )
                     except ValueError as e:
-                        fut.set_exception(e)
+                        self._forget_pending(len(tokens))
+                        # done() guard: a disconnected client may have
+                        # CANCELLED this future already; set_exception on a
+                        # cancelled future raises InvalidStateError — which
+                        # would kill the engine loop and fail the pod.
+                        if not fut.done():
+                            fut.set_exception(e)
                         continue
                     self._futures[seq.seq_id] = fut
+                # Aborts AFTER admissions: a submit-then-abort staged in
+                # the same drain cycle must find its sequence in the engine.
+                for rid, afut in aborts:
+                    try:
+                        seqs = (
+                            self.engine.abort_all()
+                            if rid is None
+                            else list(filter(None, [self.engine.abort(rid)]))
+                        )
+                    except Exception as e:
+                        afut.set_exception(e)
+                        continue
+                    for seq in seqs:
+                        self._resolve(seq)
+                    afut.set_result(bool(seqs))
+                if aborts:
+                    # An idle engine may not step again for a while; the
+                    # abort counters must not lag until it does.
+                    self.metrics.sync_lifecycle_stats(
+                        self.engine.lifecycle_stats
+                    )
                 if self.engine.has_work:
                     finished = self.engine.step()
                     if (
@@ -497,11 +804,11 @@ class PodServer:
                             prefill_tokens_s=self.engine._prefill_rate
                         )
                     self.metrics.sync_spec_stats(self.engine.spec_stats)
+                    self.metrics.sync_lifecycle_stats(
+                        self.engine.lifecycle_stats
+                    )
                     for seq in finished:
-                        self.metrics.observe_finished(seq)
-                        fut = self._futures.pop(seq.seq_id, None)
-                        if fut is not None:
-                            fut.set_result(seq)
+                        self._resolve(seq)
         except Exception as e:  # engine wedged: fail fast and visibly
             log.error("engine loop died", error=repr(e))
             self._failed = f"{type(e).__name__}: {e}"
@@ -544,7 +851,8 @@ class PodServer:
                     Heartbeat(
                         dropped_batches=getattr(
                             self._publisher, "dropped_batches", 0
-                        )
+                        ),
+                        draining=self._draining,
                     )
                 ]
             )
@@ -630,12 +938,25 @@ class PodServer:
         prompt_tokens: list[int],
         source_endpoint: str,
         timeout_s: Optional[float] = None,
+        deadline: Optional[float] = None,
     ) -> int:
         """Pull ``prompt_tokens``' warm prefix from a peer pod's export
         service and commit it locally (the router's "pull-then-compute"
         arm). Returns blocks imported; 0 on ANY failure — a pull is an
         optimization, so every error degrades to cold prefill, never to a
-        failed request."""
+        failed request. ``deadline`` (absolute monotonic, the requesting
+        request's deadline): the fetch and import waits are clamped to the
+        remaining budget, and a pull with no budget left is skipped
+        outright — cold prefill starts immediately instead of burning the
+        deadline on a transfer the client can no longer wait for."""
+        fetch_timeout: Optional[float] = None  # None = client's configured
+        wait_timeout = timeout_s or self.config.transfer_timeout_s * 3
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return 0
+            fetch_timeout = min(self.config.transfer_timeout_s, remaining)
+            wait_timeout = min(wait_timeout, remaining)
         hashes = self.engine.block_manager.token_db.prefix_hashes(prompt_tokens)
         if not hashes:
             return 0
@@ -659,12 +980,13 @@ class PodServer:
                 self._transfer_clients[source_endpoint] = client
         try:
             blocks, _complete = client.fetch(
-                self.config.model_name, hashes, self.config.transfer_max_blocks
+                self.config.model_name,
+                hashes,
+                self.config.transfer_max_blocks,
+                timeout_s=fetch_timeout,
             )
             imported = (
-                self.submit_import(blocks).result(
-                    timeout=timeout_s or self.config.transfer_timeout_s * 3
-                )
+                self.submit_import(blocks).result(timeout=wait_timeout)
                 if blocks
                 else 0
             )
@@ -681,22 +1003,117 @@ class PodServer:
         return imported
 
     # -- request path -------------------------------------------------------
+    def _retry_after_s(self, depth: int, queued_tokens: int) -> float:
+        """Retry-After hint from the measured serving rates: time to drain
+        the queue at the observed request-completion rate, falling back to
+        queued prefill work over the engine's online prefill-rate EMA.
+        Floored at 1 s (sub-second retries just re-overload) and capped at
+        60 s (past that the estimate is noise; the client should re-route).
+        """
+        est = None
+        if self.metrics.request_rate:
+            est = depth / self.metrics.request_rate
+        elif self.engine._prefill_rate and queued_tokens:
+            est = queued_tokens / self.engine._prefill_rate
+        return float(min(max(est if est is not None else 1.0, 1.0), 60.0))
+
+    def _check_admission(self, n_tokens: int) -> None:
+        """Admission control (caller holds ``_mu``): reject fast — before
+        the request touches the engine — when the configured queue-depth or
+        queued-token cap would be exceeded. Both caps off (0) = legacy
+        unbounded admission."""
+        cfg = self.config
+        if cfg.admission_max_waiting <= 0 and cfg.admission_max_queued_tokens <= 0:
+            return
+        sch = self.engine.scheduler
+        # len() snapshots of engine-owned lists: momentarily stale is fine,
+        # admission is a load shedder, not an exact semaphore.
+        active = len(sch.running) + len(sch.prefilling)
+        depth = max(self._pending - active, 0)
+        queued_tokens = self._pending_tokens
+        if cfg.admission_max_waiting > 0 and depth >= cfg.admission_max_waiting:
+            self.admission_rejected += 1
+            self.metrics.observe_rejected(draining=False)
+            raise AdmissionError(
+                f"overloaded: {depth} requests waiting >= "
+                f"ADMISSION_MAX_WAITING={cfg.admission_max_waiting}",
+                self._retry_after_s(depth, queued_tokens),
+            )
+        if (
+            cfg.admission_max_queued_tokens > 0
+            and queued_tokens + n_tokens > cfg.admission_max_queued_tokens
+        ):
+            self.admission_rejected += 1
+            self.metrics.observe_rejected(draining=False)
+            raise AdmissionError(
+                f"overloaded: {queued_tokens} + {n_tokens} queued prompt "
+                f"tokens > ADMISSION_MAX_QUEUED_TOKENS="
+                f"{cfg.admission_max_queued_tokens}",
+                self._retry_after_s(depth, queued_tokens),
+            )
+
     def submit(
-        self, prompt_tokens: list[int], sampling: Optional[SamplingParams] = None
+        self,
+        prompt_tokens: list[int],
+        sampling: Optional[SamplingParams] = None,
+        *,
+        deadline_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> Future:
         """Enqueue a request; the Future resolves to the finished Sequence
-        (or raises: invalid request, engine failure, shutdown)."""
+        (or raises: invalid request, engine failure, shutdown). Raises
+        ``AdmissionError`` when over the admission caps (fast 429 — never
+        touches the engine) and ``DrainingError`` while draining (503).
+        ``deadline_s``: per-request deadline budget in seconds (falls back
+        to ``default_deadline_s``; 0/None = none). The returned Future
+        carries ``request_id`` for ``abort``."""
         # Surface obviously-bad requests synchronously with the same checks
         # add_request applies (the rest raise through the Future).
         if not prompt_tokens:
             raise ValueError("empty prompt")
+        if deadline_s is None and self.config.default_deadline_s > 0:
+            deadline_s = self.config.default_deadline_s
+        deadline = (
+            time.monotonic() + deadline_s
+            if deadline_s is not None and deadline_s > 0
+            else None
+        )
+        rid = request_id or str(uuid.uuid4())
         fut: Future = Future()
+        fut.request_id = rid
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"engine failed: {self._failed}")
             if not self._running:
                 raise RuntimeError("pod server not running")
-            self._staging.append((list(prompt_tokens), sampling, fut))
+            if self._draining:
+                self.admission_rejected_draining += 1
+                self.metrics.observe_rejected(draining=True)
+                raise DrainingError(
+                    "pod is draining; retry against another pod"
+                )
+            self._check_admission(len(prompt_tokens))
+            self._pending += 1
+            self._pending_tokens += len(prompt_tokens)
+            self._staging.append(
+                (list(prompt_tokens), sampling, deadline, rid, fut)
+            )
+            self._work.notify()
+        return fut
+
+    def abort(self, request_id: Optional[str]) -> Future:
+        """Stage an abort onto the engine loop — the only thread allowed to
+        free pages. The Future resolves to True when a live sequence was
+        aborted (pages/slots released; its submit future resolves with the
+        partial sequence, ``finish_reason="abort"``), False when the
+        request already finished or was never admitted. ``request_id=None``
+        aborts every live request (the drain-timeout hammer)."""
+        fut: Future = Future()
+        with self._work:
+            if not self._running or self._failed is not None:
+                fut.set_result(False)
+                return fut
+            self._aborts.append((request_id, fut))
             self._work.notify()
         return fut
 
@@ -705,8 +1122,21 @@ class PodServer:
         prompt_tokens: list[int],
         sampling: Optional[SamplingParams] = None,
         timeout: Optional[float] = None,
+        *,
+        deadline_s: Optional[float] = None,
     ) -> Sequence:
-        return self.submit(prompt_tokens, sampling).result(timeout=timeout)
+        fut = self.submit(prompt_tokens, sampling, deadline_s=deadline_s)
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeout:
+            # The caller stopped waiting — the sequence must not keep
+            # decoding into the void and holding KV pages. Abort frees
+            # them; the timeout still propagates.
+            try:
+                self.abort(fut.request_id).result(timeout=30)
+            except Exception:
+                log.exception("post-timeout abort failed")
+            raise
 
     # -- HTTP surface -------------------------------------------------------
     def build_app(self):
@@ -748,12 +1178,50 @@ class PodServer:
                 return web.json_response(
                     {"error": f"invalid request field: {e}"}, status=400
                 )
+            # Per-request deadline: X-Request-Deadline header (seconds of
+            # budget), falling back to the configured default inside submit.
+            deadline_s = None
+            hdr = request.headers.get("X-Request-Deadline")
+            if hdr is not None:
+                import math
+
+                try:
+                    deadline_s = float(hdr)
+                    # NaN fails every comparison, so `<= 0` alone would
+                    # silently accept it as "no deadline" — reject instead.
+                    if not math.isfinite(deadline_s) or deadline_s <= 0:
+                        raise ValueError
+                except ValueError:
+                    return web.json_response(
+                        {"error": "invalid X-Request-Deadline (want seconds > 0)"},
+                        status=400,
+                    )
             try:
-                fut = self.submit(token_ids, sampling)
-                seq = await asyncio.wrap_future(fut)
-            except ValueError as e:  # rejected by engine admission checks
+                fut = self.submit(token_ids, sampling, deadline_s=deadline_s)
+            except AdmissionError as e:  # overloaded: fast 429, engine untouched
+                retry_after = max(int(-(-e.retry_after_s // 1)), 1)
+                return web.json_response(
+                    {"error": str(e), "retry_after_s": e.retry_after_s},
+                    status=429,
+                    headers={"Retry-After": str(retry_after)},
+                )
+            except DrainingError as e:  # rolling restart: go elsewhere
+                return web.json_response({"error": str(e)}, status=503)
+            except ValueError as e:
                 return web.json_response({"error": str(e)}, status=400)
             except RuntimeError as e:  # engine failure / shutdown
+                return web.json_response({"error": str(e)}, status=503)
+            try:
+                seq = await asyncio.wrap_future(fut)
+            except asyncio.CancelledError:
+                # Client disconnected (or the handler was cancelled): abort
+                # the sequence instead of decoding into the void — its
+                # pages free as soon as the engine loop picks the abort up.
+                self.abort(fut.request_id)
+                raise
+            except ValueError as e:  # rejected by engine admission checks
+                return web.json_response({"error": str(e)}, status=400)
+            except RuntimeError as e:  # engine failure / shutdown / drain
                 return web.json_response({"error": str(e)}, status=503)
             if seq.error:
                 return web.json_response({"error": seq.error}, status=500)
@@ -770,6 +1238,9 @@ class PodServer:
                     # not turn the response into a 500 — token ids suffice.
                     log.warning("decode failed", error=repr(e))
             stopped = bool(out_tokens) and out_tokens[-1] in sampling.stop_token_ids
+            finish_reason = seq.finish_reason or (
+                "stop" if stopped else "length"
+            )
             return web.json_response(
                 {
                     "id": seq.request_id,
@@ -780,7 +1251,7 @@ class PodServer:
                             "index": 0,
                             "text": text,
                             "token_ids": out_tokens,
-                            "finish_reason": "stop" if stopped else "length",
+                            "finish_reason": finish_reason,
                         }
                     ],
                     "usage": {
@@ -797,12 +1268,33 @@ class PodServer:
                 return web.json_response(
                     {"status": "failed", "error": self._failed}, status=503
                 )
+            if self._draining:
+                # k8s readiness must agree with admission: a draining pod
+                # takes no new traffic.
+                return web.json_response({"status": "draining"}, status=503)
             return web.json_response({"status": "ok"})
+
+        async def drain_endpoint(_request: web.Request) -> web.Response:
+            """Operator-triggered graceful drain (same path as SIGTERM).
+            Returns immediately; poll /stats (drain block) or /healthz for
+            progress. Idempotent."""
+            threading.Thread(
+                target=self.drain, name="drain", daemon=True
+            ).start()
+            return web.json_response(
+                {
+                    "status": "draining",
+                    "drain_timeout_s": self.config.drain_timeout_s,
+                },
+                status=202,
+            )
 
         async def stats(_request: web.Request) -> web.Response:
             bm = self.engine.block_manager
             with self._mu:
                 staged = len(self._staging)
+                pending = self._pending
+                pending_tokens = self._pending_tokens
                 breakers = {
                     ep: client.breaker.snapshot()
                     for ep, client in self._transfer_clients.items()
@@ -844,6 +1336,22 @@ class PodServer:
                         self._publisher, "dropped_batches", 0
                     ),
                 },
+                "admission": {
+                    "max_waiting": self.config.admission_max_waiting,
+                    "max_queued_tokens": self.config.admission_max_queued_tokens,
+                    "default_deadline_s": self.config.default_deadline_s,
+                    "pending_requests": pending,
+                    "pending_prompt_tokens": pending_tokens,
+                    "rejected": self.admission_rejected,
+                    "rejected_draining": self.admission_rejected_draining,
+                    **dict(self.engine.lifecycle_stats),
+                },
+                "drain": {
+                    "draining": self._draining,
+                    "drain_timeout_s": self.config.drain_timeout_s,
+                    "drains_started": self.drains_started,
+                    "forced_requests": self.drain_forced_requests,
+                },
             }
             return web.json_response(payload)
 
@@ -858,6 +1366,7 @@ class PodServer:
         app = web.Application()
         app.router.add_post("/v1/completions", completions)
         app.router.add_get("/healthz", healthz)
+        app.router.add_post("/drain", drain_endpoint)
         app.router.add_get("/stats", stats)
         app.router.add_get("/metrics", metrics)
         return app
@@ -911,8 +1420,20 @@ def main() -> None:
         model=config.model_name,
         zmq=config.zmq_endpoint,
     )
+    app = server.build_app()
+
+    async def _drain_on_shutdown(_app):
+        # SIGTERM path: aiohttp's GracefulExit lands here before the
+        # process dies — drain (finish inflight up to DRAIN_TIMEOUT_S,
+        # publish the final snapshot + PodDrained goodbye) so a rolling
+        # restart never leaves stale locality in the fleet for POD_TTL_S.
+        import asyncio
+
+        await asyncio.get_running_loop().run_in_executor(None, server.drain)
+
+    app.on_shutdown.append(_drain_on_shutdown)
     try:
-        web.run_app(server.build_app(), port=config.http_port)
+        web.run_app(app, port=config.http_port)
     finally:
         server.shutdown()
 

@@ -95,10 +95,21 @@ _SLOW_CLASSES = {
 }
 
 
+#: per-test wall-clock cap (seconds) applied when pytest-timeout is
+#: installed (CI installs it; local runs without it are unchanged). A
+#: deadlocked drain/abort test then fails fast with a stack dump instead of
+#: eating the whole tier-1 budget. Generous: the slowest legitimate tests
+#: (fuzz matrices, multi-config sweeps) finish well under it.
+_PER_TEST_TIMEOUT_S = 300
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest
 
+    have_timeout = config.pluginmanager.hasplugin("timeout")
     for item in items:
+        if have_timeout and item.get_closest_marker("timeout") is None:
+            item.add_marker(pytest.mark.timeout(_PER_TEST_TIMEOUT_S))
         cls = getattr(item, "cls", None)
         if cls is None:
             continue

@@ -710,3 +710,118 @@ class TestEngineFleetChaos:
             assert s.num_cached_prompt == 0
         finally:
             cold.shutdown(), ref.shutdown()
+
+    def test_drained_pod_evicted_immediately_and_never_routed(self):
+        """ISSUE 4 acceptance (c): drain → immediate fleet eviction (no
+        POD_TTL_S wait) → zero routes to the drained pod — verified against
+        engine ground truth (the drained engine still physically holds its
+        blocks; the fleet view, not the hardware, is what must forget it).
+        TTL is set huge so only the PodDrained goodbye can evict."""
+        indexer, pool, health, clock, servers, links = self._fleet(
+            n=2, ttl_s=100_000.0
+        )
+        try:
+            prefix = _prompt(20, 16)
+            baseline = servers[0].generate(
+                prefix, SamplingParams(max_new_tokens=3), timeout=120
+            )
+            assert pool.drain(timeout=10)
+            pods = ["chaos-pod-0", "chaos-pod-1"]
+            assert indexer.score_tokens(prefix, MODEL, pods)["chaos-pod-0"] > 0
+
+            # Graceful drain: inflight is empty, so the final snapshot +
+            # PodDrained goodbye publish immediately. NO clock advance —
+            # eviction must not need the TTL.
+            assert servers[0].drain(timeout_s=30) is True
+            assert pool.drain(timeout=10)
+            assert health.snapshot()["pods_drained"] == 1
+            assert (
+                index_view_of_pod(
+                    indexer.kv_block_index, MODEL, links[0].seen_hashes, "chaos-pod-0"
+                )
+                == set()
+            )
+            # Ground truth: the drained engine still holds its cache; the
+            # fleet simply must never route to it again.
+            assert engine_truth(servers[0])
+
+            assert indexer.score_tokens(prefix, MODEL, pods) == {}
+            router = BlendedRouter(
+                score_fn=lambda toks, p: indexer.score_tokens(toks, MODEL, p),
+                affinity=PrefixAffinityTracker(n_pods=2, capacity_blocks=64),
+                loads_fn=lambda p: [0.0] * len(p),
+            )
+            decision = router.route(prefix, pods)
+            assert decision.index_score == 0  # the drained pod's warmth is gone
+
+            # The drained pod itself refuses new work; the survivor serves
+            # the request cold with identical greedy output.
+            from llm_d_kv_cache_manager_tpu.server.serve import DrainingError
+
+            with pytest.raises(DrainingError):
+                servers[0].submit(prefix)
+            seq = servers[1].generate(
+                prefix, SamplingParams(max_new_tokens=3), timeout=120
+            )
+            assert seq.output_tokens == baseline.output_tokens
+            assert seq.num_cached_prompt == 0
+        finally:
+            self._teardown(pool, servers)
+
+    def test_draining_heartbeat_unroutes_before_goodbye(self):
+        """A pod advertising ``draining`` via heartbeat stops being scored
+        immediately — its entries are still indexed (the drain is not done),
+        but routing must not hand it new prefixes it is about to evict."""
+        indexer, pool, health, clock, servers, links = self._fleet(
+            n=2, ttl_s=100_000.0
+        )
+        try:
+            prefix = _prompt(21, 16)
+            servers[0].generate(prefix, SamplingParams(max_new_tokens=2), timeout=120)
+            assert pool.drain(timeout=10)
+            pods = ["chaos-pod-0", "chaos-pod-1"]
+            assert indexer.score_tokens(prefix, MODEL, pods)["chaos-pod-0"] > 0
+
+            links[0].publish([Heartbeat(draining=True)])
+            assert pool.drain(timeout=10)
+            assert indexer.score_tokens(prefix, MODEL, pods) == {}
+            # The index itself still holds the entries — only routing hides
+            # them while the drain runs.
+            assert index_view_of_pod(
+                indexer.kv_block_index, MODEL, links[0].seen_hashes, "chaos-pod-0"
+            )
+
+            # Drain cancelled (e.g. the restart was aborted): a plain
+            # heartbeat restores routability.
+            links[0].publish([Heartbeat(draining=False)])
+            assert pool.drain(timeout=10)
+            assert indexer.score_tokens(prefix, MODEL, pods)["chaos-pod-0"] > 0
+        finally:
+            self._teardown(pool, servers)
+
+    def test_drained_pod_restart_revives_routing(self):
+        """Same pod identity coming back after a PodDrained goodbye must be
+        routable again as soon as it publishes — a rolling restart reuses
+        pod names."""
+        indexer, pool, health, clock, servers, links = self._fleet(
+            n=1, ttl_s=100_000.0
+        )
+        try:
+            prefix = _prompt(22, 16)
+            servers[0].generate(prefix, SamplingParams(max_new_tokens=2), timeout=120)
+            assert pool.drain(timeout=10)
+            assert servers[0].drain(timeout_s=30) is True
+            assert pool.drain(timeout=10)
+            assert indexer.score_tokens(prefix, MODEL, ["chaos-pod-0"]) == {}
+
+            # "Restart": a fresh publisher stream under the same identity
+            # re-announces warmth via a resync snapshot.
+            digest = servers[0].engine.block_manager.block_digest()
+            links[0].publish([IndexSnapshot(blocks_by_medium=digest)])
+            assert pool.drain(timeout=10)
+            assert (
+                indexer.score_tokens(prefix, MODEL, ["chaos-pod-0"])["chaos-pod-0"]
+                > 0
+            )
+        finally:
+            self._teardown(pool, servers)

@@ -1,0 +1,651 @@
+"""Overload-protection suite (ISSUE 4 acceptance).
+
+Request-lifecycle robustness on one pod, end to end:
+
+- **Admission control**: over-cap submits fail fast with ``AdmissionError``
+  (HTTP 429 + ``Retry-After``) without touching the engine, while admitted
+  requests' greedy outputs match an un-overloaded baseline bit-for-bit.
+- **Deadlines**: expired waiting requests are shed before prefill; running
+  requests past deadline finish early with ``finish_reason="deadline"`` —
+  and either way every page returns to the pool.
+- **Abort**: ``Engine.abort`` / client disconnect / ``generate(timeout=)``
+  expiry release pages and slots mid-flight (free-page accounting returns
+  to baseline — the regression this suite pins).
+- **Graceful drain**: draining rejects with 503, finishes inflight up to
+  the budget, aborts wedged requests past it, and publishes the final
+  ``IndexSnapshot`` + ``PodDrained`` goodbye.
+- **Shutdown edges**: ``_fail_outstanding`` with queued + mid-prefill +
+  mid-decode requests fails every future, leaks nothing.
+
+All knobs default off; the rest of the suite passing unchanged is the
+bit-identical-legacy half of the acceptance criteria.
+"""
+
+import asyncio
+import threading
+import time
+from concurrent.futures import TimeoutError as FuturesTimeout
+
+import msgpack
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    EventBatch,
+    Heartbeat,
+    IndexSnapshot,
+    PodDrained,
+    decode_event_batch,
+)
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    EngineConfig,
+    SamplingParams,
+    SchedulerConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.engine import Engine
+from llm_d_kv_cache_manager_tpu.server.serve import (
+    AdmissionError,
+    DrainingError,
+    PodServer,
+    PodServerConfig,
+)
+
+PS = 4
+MODEL = "tiny-llama"
+
+
+def _engine_config(total_pages=64, **kw):
+    kw.setdefault("max_model_len", 64)
+    return EngineConfig(
+        model=TINY_LLAMA,
+        block_manager=BlockManagerConfig(total_pages=total_pages, page_size=PS),
+        scheduler=SchedulerConfig(max_prefill_batch=4, **kw.pop("scheduler_kw", {})),
+        decode_batch_size=4,
+        prefill_bucket=8,
+        interpret=True,
+        **kw,
+    )
+
+
+class RecordingPublisher:
+    """Duck-types ZMQPublisher; records batches for wire assertions."""
+
+    def __init__(self):
+        self.config = type("C", (), {"data_parallel_rank": None})()
+        self.batches: list[EventBatch] = []
+        self.dropped_batches = 0
+        self._mu = threading.Lock()
+
+    def publish(self, events, ts=None):
+        with self._mu:
+            self.batches.append(EventBatch(ts=ts or 0.0, events=list(events)))
+            return len(self.batches) - 1
+
+    def events(self, kind):
+        with self._mu:
+            return [e for b in self.batches for e in b.events if isinstance(e, kind)]
+
+    def close(self):
+        pass
+
+
+def _server(total_pages=64, publisher=None, **cfg_kw):
+    cfg = PodServerConfig(
+        model_name=MODEL,
+        pod_identifier="overload-pod",
+        publish_events=False,
+        engine=_engine_config(total_pages=total_pages, **cfg_kw.pop("engine_kw", {})),
+        **cfg_kw,
+    )
+    return PodServer(cfg, publisher=publisher)
+
+
+def _prompt(seed, n):
+    return list(
+        map(int, np.random.default_rng(seed).integers(0, TINY_LLAMA.vocab_size, n))
+    )
+
+
+def _gate_engine(server, gate):
+    """Block engine steps while ``gate`` is cleared (requests then pile up
+    in staging/waiting deterministically; admissions still run)."""
+    orig = server.engine.step
+
+    def gated_step():
+        if not gate.is_set():
+            gate.wait(10)
+        return orig()
+
+    server.engine.step = gated_step
+    return orig
+
+
+def _wait_until(predicate, timeout=30.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())
+
+
+def _baseline_free(server):
+    return server.engine.block_manager.num_free
+
+
+class TestAdmissionControl:
+    def test_caps_off_admits_unboundedly(self):
+        server = _server()
+        server.start()
+        try:
+            futs = [
+                server.submit(_prompt(i, 8), SamplingParams(max_new_tokens=2))
+                for i in range(12)
+            ]
+            assert all(f.result(timeout=120).num_generated == 2 for f in futs)
+        finally:
+            server.shutdown()
+
+    def test_max_waiting_sheds_and_admitted_match_unloaded_baseline(self):
+        """Acceptance (a): overload sheds with a fast reject while admitted
+        requests produce exactly the un-overloaded greedy outputs."""
+        prompts = [_prompt(100 + i, 8 + i) for i in range(6)]
+        baseline = _server()
+        baseline.start()
+        try:
+            expect = [
+                baseline.generate(p, SamplingParams(max_new_tokens=4), timeout=120)
+                .output_tokens
+                for p in prompts
+            ]
+        finally:
+            baseline.shutdown()
+
+        server = _server(admission_max_waiting=3)
+        gate = threading.Event()  # cleared: engine steps blocked
+        _gate_engine(server, gate)
+        server.start()
+        try:
+            results, rejected = {}, []
+            for i, p in enumerate(prompts):
+                try:
+                    results[i] = server.submit(p, SamplingParams(max_new_tokens=4))
+                except AdmissionError as e:
+                    rejected.append(i)
+                    assert e.retry_after_s >= 1.0
+            # Caps are deterministic: depth counts synchronously-admitted
+            # pending requests, and the gated engine can't drain any.
+            assert len(results) == 3 and len(rejected) == 3
+            assert server.admission_rejected == 3
+            gate.set()
+            for i, fut in results.items():
+                assert fut.result(timeout=120).output_tokens == expect[i]
+        finally:
+            gate.set()
+            server.shutdown()
+
+    def test_max_queued_tokens_cap(self):
+        server = _server(admission_max_queued_tokens=20)
+        gate = threading.Event()
+        _gate_engine(server, gate)
+        server.start()
+        try:
+            ok = server.submit(_prompt(0, 16), SamplingParams(max_new_tokens=2))
+            with pytest.raises(AdmissionError):
+                server.submit(_prompt(1, 16), SamplingParams(max_new_tokens=2))
+            gate.set()
+            assert ok.result(timeout=120).num_generated == 2
+            # Accounting drains with the queue: the next request admits.
+            fut = server.submit(_prompt(2, 16), SamplingParams(max_new_tokens=2))
+            assert fut.result(timeout=120).num_generated == 2
+        finally:
+            gate.set()
+            server.shutdown()
+
+    def test_http_429_with_retry_after(self):
+        server = _server(admission_max_waiting=1)
+        gate = threading.Event()
+        _gate_engine(server, gate)
+        server.start()
+
+        async def scenario():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                first = asyncio.create_task(
+                    client.post(
+                        "/v1/completions",
+                        json={"prompt_token_ids": _prompt(3, 8), "max_tokens": 2},
+                    )
+                )
+                await asyncio.sleep(0.2)  # first request is staged by now
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"prompt_token_ids": _prompt(4, 8), "max_tokens": 2},
+                )
+                assert resp.status == 429
+                assert int(resp.headers["Retry-After"]) >= 1
+                data = await resp.json()
+                assert "overloaded" in data["error"]
+                gate.set()
+                resp1 = await first
+                assert resp1.status == 200
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(scenario())
+        finally:
+            gate.set()
+            server.shutdown()
+
+
+class TestDeadlines:
+    def test_expired_waiting_request_shed_before_prefill(self):
+        server = _server()
+        gate = threading.Event()
+        _gate_engine(server, gate)
+        server.start()
+        free0 = _baseline_free(server)
+        try:
+            fut = server.submit(
+                _prompt(5, 8), SamplingParams(max_new_tokens=4), deadline_s=0.05
+            )
+            time.sleep(0.15)  # expire while the engine is gated
+            gate.set()
+            seq = fut.result(timeout=120)
+            assert seq.finish_reason == "deadline"
+            assert seq.num_generated == 0  # shed before any prefill compute
+            assert server.engine.prefill_stats["dispatches"] == 0
+            assert server.engine.lifecycle_stats["deadline_shed"] == 1
+            assert _baseline_free(server) == free0  # never held a page
+        finally:
+            gate.set()
+            server.shutdown()
+
+    def test_running_request_finishes_at_deadline_and_frees_pages(self):
+        # max_model_len large enough that a 10k-token ask cannot finish by
+        # length inside the deadline — the deadline must be what stops it.
+        server = _server(
+            total_pages=256, engine_kw={"max_model_len": 512}
+        )
+        server.start()
+        free0 = _baseline_free(server)
+        try:
+            seq = server.generate(
+                _prompt(6, 8),
+                SamplingParams(max_new_tokens=10_000),
+                timeout=120,
+                deadline_s=0.5,
+            )
+            assert seq.finish_reason == "deadline"
+            assert 0 < seq.num_generated < 10_000
+            assert _wait_until(
+                lambda: not server.engine.has_work
+                and _baseline_free(server) == free0
+            )
+        finally:
+            server.shutdown()
+
+    def test_default_deadline_config_applies(self):
+        server = _server(
+            default_deadline_s=0.4,
+            total_pages=256,
+            engine_kw={"max_model_len": 512},
+        )
+        server.start()
+        try:
+            seq = server.generate(
+                _prompt(7, 8), SamplingParams(max_new_tokens=10_000), timeout=120
+            )
+            assert seq.finish_reason == "deadline"
+        finally:
+            server.shutdown()
+
+    def test_http_deadline_header(self):
+        server = _server(total_pages=256, engine_kw={"max_model_len": 512})
+        server.start()
+
+        async def scenario():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"prompt_token_ids": _prompt(8, 8), "max_tokens": 10_000},
+                    headers={"X-Request-Deadline": "0.4"},
+                )
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["choices"][0]["finish_reason"] == "deadline"
+                assert 0 < len(data["choices"][0]["token_ids"]) < 10_000
+                for bad in ("bogus", "nan", "inf", "-1", "0"):
+                    resp = await client.post(
+                        "/v1/completions",
+                        json={"prompt_token_ids": _prompt(8, 8), "max_tokens": 2},
+                        headers={"X-Request-Deadline": bad},
+                    )
+                    assert resp.status == 400, bad
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(scenario())
+        finally:
+            server.shutdown()
+
+
+class TestAbort:
+    def test_engine_abort_frees_pages_mid_decode(self):
+        engine = Engine(_engine_config())
+        free0 = engine.block_manager.num_free
+        seq = engine.add_request(
+            _prompt(9, 12), SamplingParams(max_new_tokens=10_000), request_id="r1"
+        )
+        for _ in range(4):
+            engine.step()
+        assert seq.block_table  # holding pages mid-decode
+        aborted = engine.abort("r1")
+        assert aborted is seq and seq.finish_reason == "abort"
+        assert not engine.has_work
+        assert engine.block_manager.num_free == free0
+        assert engine.abort("r1") is None  # already gone
+
+    def test_generate_timeout_aborts_and_frees_pages(self):
+        """Satellite regression: Future.result(timeout=) expiry must abort
+        the request, not leak it decoding forever with its pool pages."""
+        server = _server(total_pages=512, engine_kw={"max_model_len": 2048})
+        server.start()
+        free0 = _baseline_free(server)
+        try:
+            with pytest.raises(FuturesTimeout):
+                server.generate(
+                    _prompt(10, 8),
+                    SamplingParams(max_new_tokens=100_000),
+                    timeout=0.3,
+                )
+            assert _wait_until(
+                lambda: not server.engine.has_work
+                and _baseline_free(server) == free0
+            )
+            assert server.engine.lifecycle_stats["aborted"] == 1
+            with server._mu:
+                assert server._pending == 0
+        finally:
+            server.shutdown()
+
+    def test_cancelled_future_on_invalid_request_does_not_kill_engine_loop(self):
+        """Regression: a client cancelling its future while an invalid
+        request sits staged must not blow up the engine loop's
+        set_exception (InvalidStateError would fail the whole pod)."""
+        server = _server()
+        gate = threading.Event()
+        gate.set()
+        _gate_engine(server, gate)
+        server.start()
+        try:
+            busy = server.submit(_prompt(30, 8), SamplingParams(max_new_tokens=30))
+            assert _wait_until(lambda: len(server.engine.scheduler.running) == 1)
+            gate.clear()  # loop blocks inside its next step
+            time.sleep(0.05)
+            bad = server.submit(_prompt(31, 100))  # > max_model_len: loop-side reject
+            bad.cancel()  # client walked away before admission
+            gate.set()
+            assert busy.result(timeout=120).num_generated == 30
+            assert server._failed is None  # the loop survived the cancel
+            ok = server.generate(
+                _prompt(32, 8), SamplingParams(max_new_tokens=2), timeout=120
+            )
+            assert ok.num_generated == 2
+        finally:
+            gate.set()
+            server.shutdown()
+
+    def test_abort_unknown_request_returns_false(self):
+        server = _server()
+        server.start()
+        try:
+            assert server.abort("never-admitted").result(timeout=30) is False
+        finally:
+            server.shutdown()
+
+    def test_client_disconnect_aborts_sequence(self):
+        # Big model length: the request must still be decoding when the
+        # client walks away at 0.5 s, even with warm jit caches.
+        server = _server(total_pages=512, engine_kw={"max_model_len": 2048})
+        server.start()
+        free0 = _baseline_free(server)
+
+        async def scenario():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                with pytest.raises(asyncio.TimeoutError):
+                    # The client walks away mid-generation; the handler's
+                    # cancellation must abort the sequence server-side.
+                    await asyncio.wait_for(
+                        client.post(
+                            "/v1/completions",
+                            json={
+                                "prompt_token_ids": _prompt(11, 8),
+                                "max_tokens": 100_000,
+                            },
+                        ),
+                        timeout=0.5,
+                    )
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(scenario())
+            assert _wait_until(
+                lambda: not server.engine.has_work
+                and _baseline_free(server) == free0
+            )
+            assert server.engine.lifecycle_stats["aborted"] == 1
+        finally:
+            server.shutdown()
+
+
+class TestDrain:
+    def test_drain_idle_pod_publishes_goodbye(self):
+        pub = RecordingPublisher()
+        server = _server(publisher=pub)
+        server.start()
+        try:
+            assert server.drain() is True
+            assert server.is_draining
+            with pytest.raises(DrainingError):
+                server.submit(_prompt(12, 8))
+            assert server.admission_rejected_draining == 1
+            # Final goodbye on the wire: snapshot first, then PodDrained.
+            snaps = pub.events(IndexSnapshot)
+            drains = pub.events(PodDrained)
+            assert len(snaps) == 1 and len(drains) == 1
+            flat = [e for b in pub.batches for e in b.events]
+            assert flat.index(snaps[0]) < flat.index(drains[0])
+            # Idempotent: a second drain joins the finished one.
+            assert server.drain() is True
+        finally:
+            server.shutdown()
+
+    def test_drain_waits_for_inflight(self):
+        pub = RecordingPublisher()
+        server = _server(publisher=pub)
+        server.start()
+        try:
+            fut = server.submit(_prompt(13, 8), SamplingParams(max_new_tokens=6))
+            assert server.drain(timeout_s=60) is True
+            seq = fut.result(timeout=5)  # finished, not aborted
+            assert seq.num_generated == 6 and seq.finish_reason is None
+            assert server.drain_forced_requests == 0
+        finally:
+            server.shutdown()
+
+    def test_drain_aborts_wedged_request_past_timeout(self):
+        pub = RecordingPublisher()
+        # Wedged = genuinely cannot finish inside the drain budget: needs a
+        # model length the 100k-token ask cannot exhaust in 0.4 s.
+        server = _server(
+            publisher=pub, total_pages=512, engine_kw={"max_model_len": 2048}
+        )
+        server.start()
+        free0 = _baseline_free(server)
+        try:
+            fut = server.submit(
+                _prompt(14, 8), SamplingParams(max_new_tokens=100_000)
+            )
+            assert server.drain(timeout_s=0.4) is False  # forced
+            seq = fut.result(timeout=30)
+            assert seq.finish_reason == "abort"
+            assert 0 < seq.num_generated < 100_000
+            assert server.drain_forced_requests == 1
+            assert _wait_until(
+                lambda: not server.engine.has_work
+                and _baseline_free(server) == free0
+            )
+            # The goodbye still goes out after a forced drain.
+            assert len(pub.events(PodDrained)) == 1
+        finally:
+            server.shutdown()
+
+    def test_http_drain_endpoint_and_healthz(self):
+        server = _server()
+        server.start()
+
+        async def scenario():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                resp = await client.get("/healthz")
+                assert resp.status == 200
+                resp = await client.post("/drain")
+                assert resp.status == 202
+
+                async def drained():
+                    r = await client.get("/healthz")
+                    return r.status == 503 and (await r.json())["status"] == "draining"
+
+                deadline = time.time() + 30
+                while time.time() < deadline and not await drained():
+                    await asyncio.sleep(0.02)
+                assert await drained()
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"prompt_token_ids": _prompt(15, 8), "max_tokens": 2},
+                )
+                assert resp.status == 503
+                resp = await client.get("/stats")
+                data = await resp.json()
+                assert data["drain"]["draining"] is True
+                assert data["admission"]["rejected_draining"] == 1
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(scenario())
+        finally:
+            server.shutdown()
+
+
+class TestShutdownEdges:
+    def test_fail_outstanding_queued_midprefill_middecode(self):
+        """Shutdown with the full request-state zoo inflight: a decoding
+        lane, a mid-prefill chunked ingest, and a queued request — every
+        future fails, nothing hangs, accounting zeroes."""
+        server = _server(
+            engine_kw={"scheduler_kw": {"chunked_prefill_tokens": 8}}
+        )
+        gate = threading.Event()
+        gate.set()
+        _gate_engine(server, gate)
+        server.start()
+        fut_decode = server.submit(
+            _prompt(16, 8), SamplingParams(max_new_tokens=50)
+        )
+        assert _wait_until(lambda: len(server.engine.scheduler.running) == 1)
+        fut_prefill = server.submit(
+            _prompt(17, 40), SamplingParams(max_new_tokens=4)
+        )
+        assert _wait_until(lambda: len(server.engine.scheduler.prefilling) == 1)
+        gate.clear()  # blocks the loop inside its next step (<= 10s)
+        fut_queued = server.submit(_prompt(18, 8), SamplingParams(max_new_tokens=4))
+        t = threading.Timer(0.5, gate.set)  # unblock the step mid-shutdown
+        t.start()
+        try:
+            server.shutdown()
+            for fut in (fut_decode, fut_prefill, fut_queued):
+                with pytest.raises(RuntimeError):
+                    fut.result(timeout=10)
+            with server._mu:
+                assert server._pending == 0 and server._pending_tokens == 0
+        finally:
+            t.cancel()
+            gate.set()
+
+
+class TestWireCompat:
+    def test_heartbeat_wire_bytes_unchanged_when_not_draining(self):
+        """Knobs-off wire parity: a non-draining heartbeat encodes exactly
+        the pre-PR bytes."""
+        payload = EventBatch(ts=1.0, events=[Heartbeat(dropped_batches=3)]).to_payload()
+        assert payload == msgpack.packb(
+            [1.0, [["Heartbeat", 3]]], use_bin_type=True
+        )
+
+    def test_heartbeat_draining_roundtrip(self):
+        payload = EventBatch(
+            ts=1.0, events=[Heartbeat(dropped_batches=2, draining=True)]
+        ).to_payload()
+        (ev,) = decode_event_batch(payload).events
+        assert ev == Heartbeat(dropped_batches=2, draining=True)
+        # Malformed draining field tolerated, never trusted.
+        (ev,) = decode_event_batch(
+            msgpack.packb([1.0, [["Heartbeat", 2, "yes"]]])
+        ).events
+        assert ev == Heartbeat(dropped_batches=2, draining=False)
+
+    def test_pod_drained_roundtrip(self):
+        payload = EventBatch(ts=1.0, events=[PodDrained()]).to_payload()
+        (ev,) = decode_event_batch(payload).events
+        assert ev == PodDrained()
+
+
+def test_scorer_backend_failure_degrades_to_empty_scoreboard():
+    """Satellite: an index-backend outage (Redis down) must cost cache
+    affinity, not the request — empty scoreboard + error counter, no 500."""
+    from llm_d_kv_cache_manager_tpu.kvcache.metrics import collector
+    from llm_d_kv_cache_manager_tpu.server.api import ScoringService, ServiceConfig
+
+    svc = ScoringService(ServiceConfig(native_index=False, enable_metrics=False))
+
+    def boom(*_a, **_k):
+        raise ConnectionError("redis down")
+
+    svc.indexer.get_pod_scores = boom
+    before = collector.snapshot()["scorer_errors"]
+
+    async def scenario():
+        ts = TestServer(svc.build_app())
+        client = TestClient(ts)
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/score_completions", json={"prompt": "hello", "model": MODEL}
+            )
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["scores"] == {}
+            assert "redis down" in data["degraded"]
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())
+    assert collector.snapshot()["scorer_errors"] == before + 1
