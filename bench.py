@@ -191,6 +191,9 @@ class Pod:
         self.seqs = []  # every sequence routed here
         self.hit_stats: dict[int, tuple[int, int]] = {}  # first-prefill hits
         self._first_token_seen: set[int] = set()
+        #: virtual-clock first-token / finish instants, for ITL percentiles
+        self.first_clock: dict[int, float] = {}
+        self.finish_clock: dict[int, float] = {}
         self._step_samples = deque(maxlen=64)
         self.stall_clamped_s = 0.0
         self.stall_clamped_steps = 0
@@ -220,9 +223,12 @@ class Pod:
         # Record first-token virtual times (running lanes catch prefill
         # first-tokens; `done` catches sequences that finished this step).
         sched = self.engine.scheduler
+        for seq in done:
+            self.finish_clock[seq.seq_id] = self.clock
         for seq in list(sched.running) + done:
             if seq.num_generated >= 1 and seq.seq_id not in self._first_token_seen:
                 self._first_token_seen.add(seq.seq_id)
+                self.first_clock[seq.seq_id] = self.clock
                 if seq.seq_id in arrivals:
                     ttfts[seq.seq_id] = self.clock - arrivals[seq.seq_id]
                 # Snapshot cache-hit accounting at FIRST prefill: a later
@@ -455,6 +461,20 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     out_tokens = sum(len(s.output_tokens) for p in pods for s in p.seqs)
     stall_clamped_s = sum(p.stall_clamped_s for p in pods)
     stall_clamped_steps = sum(p.stall_clamped_steps for p in pods)
+    # Per-request mean ITL on the virtual clock: (finish - first token) /
+    # (generated - 1). The serving-SLO companion to TTFT — decode-lane
+    # interference (chunked prefill, batching width) shows here first.
+    itls = np.asarray(
+        [
+            (p.finish_clock[s.seq_id] - p.first_clock[s.seq_id])
+            / (s.num_generated - 1)
+            for p in pods
+            for s in p.seqs
+            if s.num_generated > 1
+            and s.seq_id in p.first_clock
+            and s.seq_id in p.finish_clock
+        ]
+    )
     # The Pod.on_events closure references the Pod (staging buffer), so
     # Pod <-> Engine is now a reference CYCLE: without an explicit collect,
     # each policy's engines (~GBs of donated KV pools on the chip) survive
@@ -465,7 +485,12 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     return {
         "p50_ttft_s": float(np.median(all_ttfts)),
         "p90_ttft_s": float(np.percentile(all_ttfts, 90)),
+        "p99_ttft_s": float(np.percentile(all_ttfts, 99)),
         "mean_ttft_s": float(np.mean(all_ttfts)),
+        "p50_itl_s": float(np.median(itls)) if itls.size else None,
+        "p90_itl_s": float(np.percentile(itls, 90)) if itls.size else None,
+        "p99_itl_s": float(np.percentile(itls, 99)) if itls.size else None,
+        "mean_itl_s": float(np.mean(itls)) if itls.size else None,
         "p50_ttft_per_qps_segment_s": [float(np.median(s)) for s in per_seg],
         "req_s_per_chip": float(n_req / makespan / n_pods) if makespan else 0.0,
         "output_tok_s_per_chip": (
@@ -759,6 +784,19 @@ def main() -> int:
                 ),
                 "output_tok_s_per_chip": (
                     round(precise["output_tok_s_per_chip"], 1) if precise else None
+                ),
+                # Serving-SLO latency columns (precise policy): the perf
+                # trajectory tracks tails, not just medians/throughput.
+                "latency": (
+                    {
+                        k: (round(precise[k], 4) if precise[k] is not None else None)
+                        for k in (
+                            "p50_ttft_s", "p90_ttft_s", "p99_ttft_s",
+                            "p50_itl_s", "p90_itl_s", "p99_itl_s",
+                        )
+                    }
+                    if precise
+                    else None
                 ),
                 "pressure": pressure,
             }

@@ -111,6 +111,11 @@ class CostAwareMemoryIndex(Index):
             else:
                 self._recost(key)
 
+    def size_info(self) -> dict:
+        with self._lock:
+            pods = {e.pod_identifier for ps in self._data.values() for e in ps}
+            return {"blocks": len(self._data), "pods": len(pods)}
+
     def evict_pod(self, pod_identifier: str) -> int:
         removed = 0
         with self._lock:

@@ -105,6 +105,17 @@ class InMemoryIndex(Index):
                         self._data.remove(key)
                         log.trace("evicted key from index as no pods remain", key=str(key))
 
+    def size_info(self) -> dict:
+        pods: set[str] = set()
+        blocks = 0
+        # items() snapshots without promoting (the evict_pod rule): a
+        # metrics scrape must not perturb key recency.
+        for _key, pod_cache in self._data.items():
+            blocks += 1
+            with pod_cache.mu:
+                pods.update(e.pod_identifier for e in pod_cache.cache.keys())
+        return {"blocks": blocks, "pods": len(pods)}
+
     def evict_pod(self, pod_identifier: str) -> int:
         removed = 0
         # items() snapshots without promoting, so a sweep does not disturb

@@ -49,6 +49,14 @@ class Index(ABC):
         expiry and by ``IndexSnapshot`` replace-all-for-pod reconciliation.
         Returns the number of entries removed."""
 
+    def size_info(self) -> Optional[dict]:
+        """Occupancy snapshot for the ``kvcache_index_blocks`` /
+        ``kvcache_index_pods`` gauges: ``{"blocks": <tracked block keys>,
+        "pods": <distinct pods with >= 1 entry>}``. May walk the index —
+        scrape-driven callers only (``/stats``, ``/metrics``). None when
+        the backend cannot answer cheaply (e.g. a remote Redis)."""
+        return None
+
 
 @dataclass
 class InMemoryIndexConfig:

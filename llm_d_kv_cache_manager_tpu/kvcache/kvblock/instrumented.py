@@ -24,6 +24,11 @@ class InstrumentedIndex(Index):
     def inner(self) -> Index:
         return self._inner
 
+    def size_info(self):
+        # Explicit delegation: the base class has a concrete None-returning
+        # default, so __getattr__ never fires for this name.
+        return self._inner.size_info()
+
     def lookup(
         self, keys: Sequence[Key], pod_filter: Optional[set[str]] = None
     ) -> dict[Key, list[str]]:

@@ -143,6 +143,15 @@ class NativeMemoryIndex(Index):
         if pods:
             self._idx.evict(mid, key.chunk_hash, pods, tiers)
 
+    def size_info(self) -> dict:
+        # Pods = interned identifiers, i.e. pods ever seen this process;
+        # the C++ LRU does not expose a per-pod occupancy walk. Close
+        # enough for the gauge's purpose (dashboards correlating routing
+        # quality with index fill), and documented in docs/observability.md.
+        with self._mu:
+            n_pods = len(self._pod_names)
+        return {"blocks": int(self._idx.size()), "pods": n_pods}
+
     def evict_pod(self, pod_identifier: str) -> int:
         pid = self._pod_id(pod_identifier, create=False)
         if pid is None:  # never interned = never added: nothing to sweep

@@ -18,7 +18,6 @@ A periodic "metrics beat" log thread mirrors ``StartMetricsLogging``
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
 from ...utils import get_logger
@@ -36,6 +35,9 @@ class _NullMetric:
         pass
 
     def observe(self, *_a, **_k):
+        pass
+
+    def set(self, *_a, **_k):
         pass
 
     def labels(self, *_a, **_k):
@@ -64,6 +66,13 @@ breaker_closes = _NullMetric()
 # placement instead of erroring the request).
 fleet_pods_drained = _NullMetric()
 scorer_errors = _NullMetric()
+# Observability (PR 5): routing-decision counter (labeled by the blended
+# router's verdict), scorer score latency, and index-occupancy gauges so
+# dashboards can correlate routing quality with index fill.
+route_decisions = _NullMetric()
+score_latency = _NullMetric()
+index_blocks = _NullMetric()
+index_pods = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -85,7 +94,7 @@ _shadow_lock = threading.Lock()
 
 def bump(name: str, amount: int = 1) -> None:
     with _shadow_lock:
-        _shadow[name] += amount
+        _shadow[name] = _shadow.get(name, 0) + amount
 
 
 def snapshot() -> dict:
@@ -98,6 +107,7 @@ def register(registry=None) -> None:
     global _registered, admissions, evictions, lookup_requests, lookup_hits, lookup_latency
     global fleet_gaps, fleet_resyncs, fleet_pods_swept, fleet_publisher_drops
     global breaker_opens, breaker_closes, fleet_pods_drained, scorer_errors
+    global route_decisions, score_latency, index_blocks, index_pods
     with _lock:
         if _registered:
             return
@@ -172,7 +182,49 @@ def register(registry=None) -> None:
             "index backend failed",
             registry=registry,
         )
+        route_decisions = _prom.Counter(
+            "kvcache_scorer_route_decisions_total",
+            "Blended-router routing decisions by verdict "
+            "(route_warm / pull / cold)",
+            ["decision"],
+            registry=registry,
+        )
+        score_latency = _prom.Histogram(
+            "kvcache_scorer_score_seconds",
+            "Wall time of one scoring request (tokenize + hash + index "
+            "lookup + score), as served by the scoring API",
+            registry=registry,
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+        )
+        index_blocks = _prom.Gauge(
+            "kvcache_index_blocks",
+            "Block keys currently tracked by the KV-block index "
+            "(refreshed on /stats and /metrics scrapes)",
+            registry=registry,
+        )
+        index_pods = _prom.Gauge(
+            "kvcache_index_pods",
+            "Distinct pods currently holding at least one index entry "
+            "(refreshed on /stats and /metrics scrapes)",
+            registry=registry,
+        )
         _registered = True
+
+
+def observe_route_decision(action: str) -> None:
+    """One blended-router verdict (route_warm / pull / cold)."""
+    bump(f"route_decisions_{action}")
+    route_decisions.labels(decision=action).inc()
+
+
+def set_index_size(blocks: int, pods: int) -> None:
+    """Refresh the index-occupancy gauges (scrape-driven, not event-driven:
+    walking the index is O(keys), so only /stats and /metrics pay it)."""
+    index_blocks.set(blocks)
+    index_pods.set(pods)
+    with _shadow_lock:
+        _shadow["index_blocks"] = blocks
+        _shadow["index_pods"] = pods
 
 
 _beat_thread: Optional[threading.Thread] = None
@@ -195,5 +247,14 @@ def start_metrics_logging(interval_seconds: float) -> None:
         _beat_thread.start()
 
 
-def stop_metrics_logging() -> None:
+def stop_metrics_logging(timeout: float = 2.0) -> None:
+    """Stop the metrics beat and JOIN the thread. Without the join (the
+    pre-PR-5 bug) a stop/start pair in one process raced: ``start`` saw the
+    old thread still alive, returned early, and the beat never restarted —
+    and the half-dead thread leaked past interpreter teardown checks."""
+    global _beat_thread
     _beat_stop.set()
+    with _lock:
+        thread, _beat_thread = _beat_thread, None
+    if thread is not None and thread.is_alive():
+        thread.join(timeout=timeout)

@@ -41,6 +41,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 from .kvblock.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
+from .metrics import collector
 
 
 class PrefixAffinityTracker:
@@ -166,6 +167,16 @@ class BlendedRouter:
                 elif verdict == "cold":
                     target, action = coldest, "cold"
         self.affinity.record(keys, target, now)
+        # Routing-quality observability: verdict counts let dashboards see
+        # the warm/pull/cold mix shift as the fleet warms or thrashes
+        # (kvcache_scorer_route_decisions_total{decision=...}). The metric
+        # label reports the PLACEMENT QUALITY, not the code path: the
+        # default "route_warm" action with a zero index score is a cold
+        # placement (cold fleet, or no cost model) and must count as one —
+        # otherwise the counter reads 100% warm exactly when nothing is.
+        collector.observe_route_decision(
+            "cold" if action == "route_warm" and warm_blocks == 0 else action
+        )
         # Decision metadata is DECISION-time state (what drove the pick),
         # captured before record() refreshes the affinity memory.
         return RoutingDecision(

@@ -200,6 +200,7 @@ class KVTransferClient:
         block_hashes: Sequence[int],
         max_blocks: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        traceparent: Optional[str] = None,
     ) -> tuple[list[BlockPayload], bool]:
         """Fetch the longest resident prefix of ``block_hashes`` from the
         peer. Returns ``(blocks, complete)``; raises ``TransferError`` on
@@ -207,7 +208,9 @@ class KVTransferClient:
         a tripped breaker the error is raised immediately — no socket I/O,
         no timeout wait. ``timeout_s`` overrides the configured poll
         deadline for this call — the hook request-deadline callers use to
-        clamp a pull to the request's remaining budget."""
+        clamp a pull to the request's remaining budget. ``traceparent``
+        (W3C) rides the request envelope so the exporting peer's spans
+        join the puller's trace; None (default) keeps legacy wire bytes."""
         if not block_hashes:
             return [], True
         if self.breaker is not None and not self.breaker.allow():
@@ -218,7 +221,7 @@ class KVTransferClient:
             )
         try:
             blocks, complete = self._fetch_once(
-                model_name, block_hashes, max_blocks, timeout_s
+                model_name, block_hashes, max_blocks, timeout_s, traceparent
             )
         except Exception:
             # Any failure settles the breaker (a stuck half-open probe
@@ -236,6 +239,7 @@ class KVTransferClient:
         block_hashes: Sequence[int],
         max_blocks: Optional[int],
         timeout_s: Optional[float] = None,
+        traceparent: Optional[str] = None,
     ) -> tuple[list[BlockPayload], bool]:
         import zmq
 
@@ -246,7 +250,11 @@ class KVTransferClient:
             sock = self._socket()
             t0 = time.perf_counter()
             try:
-                sock.send(encode_request(model_name, block_hashes, max_blocks))
+                sock.send(
+                    encode_request(
+                        model_name, block_hashes, max_blocks, traceparent
+                    )
+                )
                 if not sock.poll(int(deadline_s * 1000), zmq.POLLIN):
                     self._reset_socket()  # a late reply must not leak forward
                     raise TransferError(

@@ -5,7 +5,10 @@ array-encoded tagged unions, positional and tolerant decoding (missing
 trailing fields default, malformed messages decode to ``None`` rather than
 raising — a poison request must never kill the export service).
 
-- request: ``["FetchBlocks", model_name, [block_hash, ...], max_blocks]``
+- request: ``["FetchBlocks", model_name, [block_hash, ...], max_blocks,
+  traceparent?]`` (the optional trailing W3C ``traceparent`` joins the
+  exporting peer's spans to the puller's trace — appended ONLY when
+  tracing is on, so default wire bytes are unchanged)
 - response: ``["Blocks", complete, [[hash, parent_hash, token_ids,
   block_size, dtype, shape, k_data, v_data], ...]]``
 - error: ``["TransferError", message]``
@@ -48,16 +51,31 @@ class BlockPayload:
 
 
 def encode_request(
-    model_name: str, block_hashes: Sequence[int], max_blocks: Optional[int] = None
+    model_name: str,
+    block_hashes: Sequence[int],
+    max_blocks: Optional[int] = None,
+    traceparent: Optional[str] = None,
 ) -> bytes:
-    return msgpack.packb(
-        [FETCH_BLOCKS_TAG, model_name, [int(h) for h in block_hashes], max_blocks],
-        use_bin_type=True,
-    )
+    arr: list = [
+        FETCH_BLOCKS_TAG,
+        model_name,
+        [int(h) for h in block_hashes],
+        max_blocks,
+    ]
+    if traceparent is not None:
+        # Trailing optional field: only on the wire when tracing is on, so
+        # the no-knobs request bytes stay bit-identical and old services
+        # (positional, tolerant) simply ignore it.
+        arr.append(traceparent)
+    return msgpack.packb(arr, use_bin_type=True)
 
 
-def decode_request(payload: bytes) -> Optional[tuple[str, list[int], Optional[int]]]:
-    """``(model_name, block_hashes, max_blocks)`` or None for garbage."""
+def decode_request(
+    payload: bytes,
+) -> Optional[tuple[str, list[int], Optional[int], Optional[str]]]:
+    """``(model_name, block_hashes, max_blocks, traceparent)`` or None for
+    garbage. ``traceparent`` is None when absent or non-string (tolerant:
+    a malformed trace field must never fail the fetch)."""
     arr = _unpack(payload)
     if (
         not isinstance(arr, (list, tuple))
@@ -79,7 +97,10 @@ def decode_request(payload: bytes) -> Optional[tuple[str, list[int], Optional[in
             max_blocks = int(max_blocks)
         except (TypeError, ValueError):
             return None
-    return model, hashes, max_blocks
+    traceparent = _text(arr[4]) if len(arr) > 4 else None
+    if not isinstance(traceparent, str):
+        traceparent = None
+    return model, hashes, max_blocks, traceparent
 
 
 def encode_response(blocks: Sequence[BlockPayload], complete: bool) -> bytes:

@@ -47,9 +47,15 @@ class KVTransferService:
         self,
         config: TransferServiceConfig,
         handler: Callable[[list[int], int], Sequence[BlockPayload]],
+        tracer=None,
     ):
+        """``tracer`` (an ``obs.Tracer``, optional): when tracing is on,
+        each served fetch records a ``transfer.export`` span, parented on
+        the ``traceparent`` the puller carried in the request envelope —
+        the exporting peer's time joins the pulling request's trace."""
         self.config = config
         self.handler = handler
+        self.tracer = tracer
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: observability, read by /stats
@@ -115,23 +121,43 @@ class KVTransferService:
         req = decode_request(payload)
         if req is None:
             return encode_error("malformed request")
-        model, hashes, max_blocks = req
-        if model != self.config.model_name:
-            return encode_error(
-                f"model mismatch: serving {self.config.model_name!r}"
+        model, hashes, max_blocks, traceparent = req
+        span = None
+        if self.tracer is not None and self.tracer.enabled:
+            from ...obs.tracing import parse_traceparent
+
+            span = self.tracer.start_span(
+                "transfer.export",
+                parent=parse_traceparent(traceparent),
+                attrs={"model": model, "requested_blocks": len(hashes)},
             )
-        cap = self.config.max_blocks
-        if max_blocks is not None and max_blocks > 0:
-            cap = min(cap, max_blocks)
         try:
-            blocks = list(self.handler(hashes[:cap], cap))
-        except Exception as e:
-            log.exception("transfer handler failed")
-            return encode_error(f"export failed: {type(e).__name__}")
-        blocks, complete = self._cap_bytes(blocks, len(hashes))
-        self.requests_served += 1
-        self.blocks_served += len(blocks)
-        return encode_response(blocks, complete)
+            if model != self.config.model_name:
+                return encode_error(
+                    f"model mismatch: serving {self.config.model_name!r}"
+                )
+            cap = self.config.max_blocks
+            if max_blocks is not None and max_blocks > 0:
+                cap = min(cap, max_blocks)
+            try:
+                blocks = list(self.handler(hashes[:cap], cap))
+            except Exception as e:
+                log.exception("transfer handler failed")
+                if span is not None:
+                    span.set_attr("error", type(e).__name__)
+                return encode_error(f"export failed: {type(e).__name__}")
+            blocks, complete = self._cap_bytes(blocks, len(hashes))
+            self.requests_served += 1
+            self.blocks_served += len(blocks)
+            if span is not None:
+                span.set_attr("served_blocks", len(blocks))
+                span.set_attr(
+                    "wire_bytes", sum(b.wire_bytes for b in blocks)
+                )
+            return encode_response(blocks, complete)
+        finally:
+            if span is not None:
+                span.end()
 
     def _cap_bytes(
         self, blocks: list[BlockPayload], n_requested: int

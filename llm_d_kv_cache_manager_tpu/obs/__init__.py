@@ -1,0 +1,17 @@
+"""Fleet observability: request tracing + latency decomposition.
+
+The tracing layer is dependency-free (stdlib only) and off by default —
+``OBS_TRACING=1`` turns it on per process. Spans propagate across the
+scorer → pod → transfer-peer hop via W3C ``traceparent`` (HTTP headers on
+the scoring/serving APIs, a trailing optional field in the KV-transfer
+msgpack envelope), so one request's time is attributable end to end.
+"""
+
+from .tracing import (  # noqa: F401
+    NOOP_SPAN,
+    Span,
+    SpanContext,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)

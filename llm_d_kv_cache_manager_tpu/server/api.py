@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -39,9 +40,10 @@ from ..kvcache.kvevents import (
     ZMQSubscriber,
     ZMQSubscriberConfig,
 )
+from ..obs.tracing import Tracer, format_traceparent, parse_traceparent
 from ..preprocessing import ChatTemplatingProcessor, FetchTemplateRequest, RenderRequest
 from ..tokenization import HFTokenizerConfig, TokenizationPoolConfig
-from ..utils import get_logger
+from ..utils import get_logger, log_context
 
 log = get_logger("server.api")
 
@@ -64,6 +66,14 @@ class ServiceConfig:
     #: swept from the index and it stops being scored. 0 (default) = off —
     #: observation-only health tracking, legacy routing behavior.
     pod_ttl_s: float = 0.0
+    #: request tracing (PR 5): mint-or-adopt a W3C trace id per scoring
+    #: request, record a ``scorer.score`` span, echo the ``traceparent``
+    #: response header for the router to forward, and serve finished
+    #: traces at ``GET /debug/traces``. Off (default) = no new headers,
+    #: bit-identical responses.
+    obs_tracing: bool = False
+    #: finished-span ring size for /debug/traces
+    obs_trace_buffer: int = 2048
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -80,6 +90,9 @@ class ServiceConfig:
             metrics_logging_interval=float(env.get("METRICS_LOGGING_INTERVAL", "0")),
             native_index=env.get("NATIVE_INDEX", "1").lower() not in ("0", "false"),
             pod_ttl_s=float(env.get("POD_TTL_S", "0")),
+            obs_tracing=env.get("OBS_TRACING", "").strip().lower()
+            in ("1", "true", "yes", "on"),
+            obs_trace_buffer=int(env.get("OBS_TRACE_BUFFER", "2048")),
         )
 
 
@@ -145,6 +158,12 @@ class ScoringService:
             ZMQSubscriberConfig(endpoint=cfg.zmq_endpoint, topic_filter=cfg.zmq_topic),
         )
         self.chat = ChatTemplatingProcessor()
+        #: request tracing (OBS_TRACING; a disabled tracer is free)
+        self.tracer = Tracer(
+            enabled=cfg.obs_tracing,
+            max_spans=cfg.obs_trace_buffer,
+            service="scorer",
+        )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -181,21 +200,58 @@ class ScoringService:
                 status=400,
             )
         pods = body.get("pod_identifiers") or []
-        loop = asyncio.get_running_loop()
-        try:
-            scores = await loop.run_in_executor(
-                None, self.indexer.get_pod_scores, prompt, model, pods
+        headers, scores, degraded = await self._traced_score(
+            request, "/score_completions", prompt, model, pods
+        )
+        if degraded is not None:
+            return web.json_response(
+                {"scores": {}, "degraded": degraded}, headers=headers
             )
-        except Exception as exc:
-            # Index backend down (e.g. Redis unreachable): degrade to an
-            # empty scoreboard — the router falls back to a cold placement
-            # and the REQUEST still serves, just without cache affinity. A
-            # 500 here would turn an index outage into a serving outage.
-            log.exception("scoring failed; degrading to empty scoreboard")
-            collector.bump("scorer_errors")
-            collector.scorer_errors.inc()
-            return web.json_response({"scores": {}, "degraded": str(exc)})
-        return web.json_response({"scores": scores})
+        return web.json_response({"scores": scores}, headers=headers)
+
+    async def _traced_score(
+        self, request: web.Request, endpoint: str, prompt: str, model: str, pods
+    ):
+        """The one scoring path both endpoints share: trace mint-or-adopt
+        (the scoring service is the fleet's front door, so the trace id
+        established here is the one the router forwards to the serving pod
+        and the pod to its transfer peer), score off the event loop, score
+        latency + degradation accounting. Returns ``(headers, scores,
+        degraded)`` — ``degraded`` is the error string when the index
+        backend failed: degrade to an empty scoreboard so the router falls
+        back to a cold placement and the REQUEST still serves, just
+        without cache affinity (a 500 here would turn an index outage
+        into a serving outage)."""
+        loop = asyncio.get_running_loop()
+        span = self.tracer.start_span(
+            "scorer.score",
+            parent=parse_traceparent(request.headers.get("traceparent"))
+            if self.tracer.enabled
+            else None,
+            attrs={"endpoint": endpoint, "model": model},
+        )
+        headers = (
+            {"traceparent": format_traceparent(span.context)}
+            if span.context is not None
+            else None
+        )
+        with span, log_context(
+            trace_id=span.context.trace_id if span.context else None
+        ):
+            t0 = time.perf_counter()
+            try:
+                scores = await loop.run_in_executor(
+                    None, self.indexer.get_pod_scores, prompt, model, pods
+                )
+            except Exception as exc:
+                log.exception("scoring failed; degrading to empty scoreboard")
+                collector.bump("scorer_errors")
+                collector.scorer_errors.inc()
+                span.set_attr("error", type(exc).__name__)
+                return headers, None, str(exc)
+            collector.score_latency.observe(time.perf_counter() - t0)
+            span.set_attr("pods_scored", len(scores))
+        return headers, scores, None
 
     async def handle_score_chat_completions(self, request: web.Request) -> web.Response:
         try:
@@ -241,24 +297,40 @@ class ScoringService:
         except Exception as exc:
             log.exception("chat template render failed")
             return web.json_response({"error": str(exc)}, status=400)
-        try:
-            scores = await loop.run_in_executor(
-                None,
-                self.indexer.get_pod_scores,
-                prompt,
-                model,
-                body.get("pod_identifiers") or [],
-            )
-        except Exception as exc:
+        headers, scores, degraded = await self._traced_score(
+            request, "/score_chat_completions", prompt, model,
+            body.get("pod_identifiers") or [],
+        )
+        if degraded is not None:
             # Index backend down: same degradation contract as
             # /score_completions — cost cache affinity, not the request.
-            log.exception("chat scoring failed; degrading to empty scoreboard")
-            collector.bump("scorer_errors")
-            collector.scorer_errors.inc()
-            return web.json_response({"scores": {}, "degraded": str(exc)})
-        return web.json_response({"scores": scores, "rendered_prompt_chars": len(prompt)})
+            return web.json_response(
+                {"scores": {}, "degraded": degraded}, headers=headers
+            )
+        return web.json_response(
+            {"scores": scores, "rendered_prompt_chars": len(prompt)},
+            headers=headers,
+        )
+
+    def _refresh_index_gauges(self) -> Optional[dict]:
+        """Scrape-driven index-occupancy snapshot: updates the
+        ``kvcache_index_blocks`` / ``kvcache_index_pods`` gauges and
+        returns the raw dict for /stats (None when the backend cannot
+        answer cheaply, e.g. Redis). The walk is O(index keys) — callers
+        on the event loop must push it to the executor."""
+        try:
+            info = self.indexer.kv_block_index.size_info()
+        except Exception:
+            log.exception("index size_info failed")
+            return None
+        if info is not None:
+            collector.set_index_size(info["blocks"], info["pods"])
+        return info
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._refresh_index_gauges
+        )
         try:
             import prometheus_client
 
@@ -280,6 +352,12 @@ class ScoringService:
         the index collector's shadow counters."""
         from ..kvcache.metrics import collector
 
+        # Occupancy first (off the event loop — O(index keys) walk), so the
+        # snapshot below carries the fresh index_blocks/index_pods shadow
+        # values too.
+        index_size = await asyncio.get_running_loop().run_in_executor(
+            None, self._refresh_index_gauges
+        )
         return web.json_response(
             {
                 "fleet": self.fleet_health.snapshot(),
@@ -289,9 +367,16 @@ class ScoringService:
                 "events_rejected_after_shutdown": (
                     self.events_pool.rejected_after_shutdown
                 ),
+                "index_size": index_size,
                 "index": collector.snapshot(),
             }
         )
+
+    async def handle_debug_traces(self, request: web.Request) -> web.Response:
+        from ..obs.tracing import debug_traces_payload
+
+        status, payload = debug_traces_payload(self.tracer, request.query)
+        return web.json_response(payload, status=status)
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -300,6 +385,7 @@ class ScoringService:
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_get("/stats", self.handle_stats)
+        app.router.add_get("/debug/traces", self.handle_debug_traces)
         return app
 
 

@@ -379,6 +379,22 @@ class Engine:
             "deadline_expired": 0,
             "aborted": 0,
         }
+        #: engine-step telemetry (PR 5, ``OBS_METRICS``): cumulative wall
+        #: seconds per step phase — schedule (deadline shed + scheduler),
+        #: prefill (dispatch + sampling), decode (dispatch + commit),
+        #: gather (host<->device page moves, overlaps prefill/decode),
+        #: publish (finish detection + KV-event flush). Off by default:
+        #: ``obs_step_timing=False`` skips every clock read, so the legacy
+        #: step path is untouched.
+        self.obs_step_timing = False
+        self.step_stats = {
+            "steps": 0,
+            "schedule_s": 0.0,
+            "prefill_s": 0.0,
+            "decode_s": 0.0,
+            "gather_s": 0.0,
+            "publish_s": 0.0,
+        }
         #: in-flight fused decode burst (decode_pipeline): toks device
         #: array, lane-ordered active list, and the np position/len arrays
         #: the NEXT burst derives from.
@@ -441,6 +457,7 @@ class Engine:
     def _flush_page_moves(self) -> None:
         if not self._pending_offloads and not self._pending_restores:
             return
+        t_flush = time.perf_counter() if self.obs_step_timing else 0.0
         # One batched gather for every device page any queued move reads.
         need = []
         for _, src in self._pending_offloads + self._pending_restores:
@@ -512,6 +529,8 @@ class Engine:
         self._pending_restores.clear()
         self._off_by_slot.clear()
         self._restore_by_page.clear()
+        if self.obs_step_timing:
+            self.step_stats["gather_s"] += time.perf_counter() - t_flush
 
     # -- cross-pod KV transfer (kvcache/transfer) ---------------------------
     @property
@@ -768,6 +787,8 @@ class Engine:
         step — a budgeted chunk batch *and* every running decode lane —
         and both dispatch in the same iteration, so a long prompt's ingest
         never stalls running decodes for more than one chunk's compute."""
+        timed = self.obs_step_timing
+        t0 = time.perf_counter() if timed else 0.0
         shed: list[Sequence] = []
         if self._deadlines_used:
             # Deadline shedding BEFORE scheduling: an expired waiting seq
@@ -780,11 +801,17 @@ class Engine:
                 self.lifecycle_stats["deadline_shed"] += 1
                 self.finished.append(seq)
         out = self.scheduler.schedule()
+        if timed:
+            t1 = time.perf_counter()
+            self.step_stats["schedule_s"] += t1 - t0
         if out.prefill:
             # Prefill must see committed decode state (page accounting,
             # finish detection) — never overlaps an in-flight burst.
             self._drain_inflight()
             self._run_prefill(out.prefill, out.chunks)
+        if timed:
+            t2 = time.perf_counter()
+            self.step_stats["prefill_s"] += t2 - t1
         if out.decode:
             # Mixed step: decode lanes snapshotted at schedule time — a
             # final-chunk sequence published above joins NEXT step (same
@@ -794,6 +821,9 @@ class Engine:
             self._run_decode(out.decode)
         elif not out.prefill:
             self._drain_inflight()
+        if timed:
+            t3 = time.perf_counter()
+            self.step_stats["decode_s"] += t3 - t2
 
         newly_finished = list(shed)
         for seq in list(self.scheduler.running):
@@ -804,6 +834,9 @@ class Engine:
                 newly_finished.append(seq)
 
         self.block_manager.flush_events()
+        if timed:
+            self.step_stats["publish_s"] += time.perf_counter() - t3
+            self.step_stats["steps"] += 1
         self._step_count += 1
         return newly_finished
 
@@ -864,7 +897,12 @@ class Engine:
         ctx_bt = np.zeros((b, ctx_pages), np.int32)
         ctx_lens = np.zeros((b,), np.int32)
 
+        # Queue→compute boundary for the latency decomposition: one clock
+        # read per batch, stamped only on each sequence's FIRST chunk.
+        t_prefill_start = time.monotonic()
         for i, (seq, n) in enumerate(zip(seqs, chunks)):
+            if seq.prefill_start_time is None:
+                seq.prefill_start_time = t_prefill_start
             start = seq.num_prefilled
             tokens[i, :n] = seq.prompt_tokens[start : start + n]
             pos = np.arange(start, start + n)

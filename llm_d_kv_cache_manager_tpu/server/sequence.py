@@ -57,6 +57,19 @@ class Sequence:
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    #: when the first prefill chunk for this sequence dispatched — the
+    #: queue→compute boundary the latency decomposition (queue span /
+    #: kvcache_request_queue_seconds) is derived from. Always stamped
+    #: (one clock read per prefill batch; no behavior change).
+    prefill_start_time: Optional[float] = None
+    #: the router's verdict that placed this request here ("route_warm" /
+    #: "pull" / "cold"), when the serving layer knows it — labels the
+    #: latency histograms; None = derived from num_cached_prompt.
+    route_action: Optional[str] = None
+    #: live ``obs.tracing.Span`` for the request (serving layer owns it;
+    #: child queue/prefill/decode spans are reconstructed from the
+    #: timestamps above when the request resolves). None = tracing off.
+    trace_span: Optional[object] = None
     #: absolute monotonic deadline (``time.monotonic()`` scale). None
     #: (default) = no deadline — bit-identical legacy behavior. An expired
     #: waiting sequence is shed before prefill; an expired running sequence

@@ -53,7 +53,8 @@ from ..kvcache.transfer import (
     TransferServiceConfig,
 )
 from ..models import LlamaConfig
-from ..utils import get_logger
+from ..obs.tracing import Tracer, format_traceparent, parse_traceparent
+from ..utils import get_logger, log_context
 from .engine import Engine, EngineConfig
 from .block_manager import BlockManagerConfig
 from .sequence import SamplingParams, Sequence
@@ -81,13 +82,17 @@ class _ServingMetrics:
     collector): request/token counters, prefix-cache savings, TTFT histogram.
     Inert when prometheus_client is unavailable."""
 
-    def __init__(self):
+    def __init__(self, obs: bool = False):
+        """``obs``: build the PR-5 latency-decomposition histograms and
+        engine-step telemetry series (``OBS_METRICS``). Off (default)
+        keeps the exposition surface bit-identical to previous rounds."""
         # Measured serving rates (EMAs over request completions), kept
         # OUTSIDE the prometheus guard: admission control derives its
         # Retry-After hint from them, with or without prometheus_client.
         self.request_rate: Optional[float] = None  # finished requests / s
         self.token_rate: Optional[float] = None  # generated tokens / s
         self._last_finish: Optional[float] = None
+        self._obs = bool(obs)
         try:
             import prometheus_client as prom
         except ImportError:  # pragma: no cover
@@ -185,6 +190,155 @@ class _ServingMetrics:
         self._lifecycle_seen = {
             "deadline_shed": 0, "deadline_expired": 0, "aborted": 0,
         }
+        # Latency decomposition + engine-step telemetry (PR 5): built only
+        # under OBS_METRICS so the default exposition stays unchanged.
+        if self._obs:
+            slo_buckets = (
+                0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+            )
+            req_labels = ["outcome", "finish"]
+            self.req_ttft = prom.Histogram(
+                "kvcache_request_ttft_seconds",
+                "Time to first token, by cache outcome (warm/pull/cold) "
+                "and finish reason",
+                req_labels, registry=self.registry, buckets=slo_buckets,
+            )
+            self.req_itl = prom.Histogram(
+                "kvcache_request_itl_seconds",
+                "Mean inter-token latency per request "
+                "((finish - first token) / (generated - 1))",
+                req_labels, registry=self.registry, buckets=slo_buckets,
+            )
+            self.req_queue = prom.Histogram(
+                "kvcache_request_queue_seconds",
+                "Submit-to-first-prefill-dispatch wait",
+                req_labels, registry=self.registry, buckets=slo_buckets,
+            )
+            self.req_e2e = prom.Histogram(
+                "kvcache_request_e2e_seconds",
+                "Submit-to-finish wall time",
+                req_labels, registry=self.registry, buckets=slo_buckets,
+            )
+            self.transfer_pull = prom.Histogram(
+                "kvcache_transfer_pull_seconds",
+                "pull_prefix wall time (fetch + import), by outcome "
+                "(ok/empty/failed)",
+                ["outcome"], registry=self.registry, buckets=slo_buckets,
+            )
+            self.engine_steps = prom.Counter(
+                "kvcache_engine_steps_total",
+                "Engine iterations",
+                registry=self.registry,
+            )
+            self.engine_phase_s = prom.Counter(
+                "kvcache_engine_step_phase_seconds_total",
+                "Cumulative engine-step wall seconds by phase (schedule/"
+                "prefill/decode/gather/publish; gather overlaps the "
+                "dispatch phases)",
+                ["phase"], registry=self.registry,
+            )
+            self.engine_occupancy = prom.Gauge(
+                "kvcache_engine_batch_occupancy",
+                "Running decode lanes / decode_batch_size",
+                registry=self.registry,
+            )
+            self.engine_free_pages = prom.Gauge(
+                "kvcache_engine_free_pages",
+                "Free KV pages in the HBM pool",
+                registry=self.registry,
+            )
+            self.engine_loop_lag = prom.Gauge(
+                "kvcache_engine_loop_lag_seconds",
+                "EMA of host-side gap between engine iterations while work "
+                "was pending (staging, bookkeeping, GIL pressure)",
+                registry=self.registry,
+            )
+            self._step_seen = dict.fromkeys(
+                ("schedule_s", "prefill_s", "decode_s", "gather_s", "publish_s"),
+                0.0,
+            )
+            self._steps_seen = 0
+
+    def observe_pull(self, seconds: float, outcome: str) -> None:
+        """One ``pull_prefix`` attempt: outcome ok (imported >= 1 block),
+        empty (nothing to pull — no hashes, or peer had no warm blocks),
+        skipped (never attempted: deadline budget exhausted or the pod is
+        shutting down — the overload signal, kept distinct from empty), or
+        failed (fetch/import error, fell back to cold)."""
+        if self._prom is None or not self._obs:
+            return
+        self.transfer_pull.labels(outcome=outcome).observe(seconds)
+
+    def sync_step_stats(self, step_stats: dict, lag_s: Optional[float]) -> None:
+        """Mirror the engine's cumulative step-phase seconds into the
+        labeled counter (delta sync, same pattern as spec/lifecycle)."""
+        if self._prom is None or not self._obs:
+            return
+        steps = step_stats.get("steps", 0)
+        if steps > self._steps_seen:
+            self.engine_steps.inc(steps - self._steps_seen)
+            self._steps_seen = steps
+        for key, seen in self._step_seen.items():
+            delta = step_stats.get(key, 0.0) - seen
+            if delta > 0:
+                self.engine_phase_s.labels(phase=key[:-2]).inc(delta)
+                self._step_seen[key] = step_stats[key]
+        if lag_s is not None:
+            self.engine_loop_lag.set(lag_s)
+
+    def set_engine_gauges(self, occupancy: float, free_pages: int) -> None:
+        if self._prom is None or not self._obs:
+            return
+        self.engine_occupancy.set(occupancy)
+        self.engine_free_pages.set(free_pages)
+
+    @staticmethod
+    def request_labels(seq: Sequence) -> tuple[str, str]:
+        """(outcome, finish) labels for the request histograms: outcome =
+        "pull" when the router's verdict was a transfer pull, else the
+        measured prefix-cache hit ("warm"/"cold"); finish = the
+        early-finish reason or the normal stop/length verdict."""
+        # Ground truth decides warm vs cold (a router that said "warm" on
+        # a cold fleet still ran a cold prefill here — the pod's
+        # histograms must agree with the scorer-side route_decisions
+        # correction in router.py, not with the router's optimism); only
+        # the "pull" verdict is kept as its own class.
+        if seq.route_action == "pull":
+            outcome = "pull"
+        else:
+            outcome = "warm" if seq.num_cached_prompt else "cold"
+        finish = seq.finish_reason
+        if finish is None:
+            finish = (
+                "length"
+                if seq.num_generated >= seq.sampling.max_new_tokens
+                else "stop"
+            )
+        return outcome, finish
+
+    def observe_request_decomposition(self, seq: Sequence) -> None:
+        """Latency-decomposition histograms from the timestamps the engine
+        already stamps (no extra clock reads on the hot path)."""
+        if self._prom is None or not self._obs:
+            return
+        outcome, finish = self.request_labels(seq)
+        lab = {"outcome": outcome, "finish": finish}
+        if seq.ttft is not None:
+            self.req_ttft.labels(**lab).observe(seq.ttft)
+        if seq.prefill_start_time is not None:
+            self.req_queue.labels(**lab).observe(
+                max(seq.prefill_start_time - seq.arrival_time, 0.0)
+            )
+        if seq.finish_time is not None:
+            self.req_e2e.labels(**lab).observe(
+                max(seq.finish_time - seq.arrival_time, 0.0)
+            )
+            if seq.first_token_time is not None and seq.num_generated > 1:
+                self.req_itl.labels(**lab).observe(
+                    max(seq.finish_time - seq.first_token_time, 0.0)
+                    / (seq.num_generated - 1)
+                )
 
     def sync_lifecycle_stats(self, stats: dict) -> None:
         """Mirror the engine's monotone lifecycle counters (deadline sheds/
@@ -263,6 +417,8 @@ class _ServingMetrics:
             self.cached_prompt.inc(seq.num_cached_prompt)
         if seq.ttft is not None:
             self.ttft.observe(seq.ttft)
+        if self._obs:
+            self.observe_request_decomposition(seq)
 
     def exposition(self) -> Optional[bytes]:
         if self._prom is None:
@@ -329,6 +485,22 @@ class PodServerConfig:
     #: graceful drain: how long inflight requests get to finish after
     #: SIGTERM / ``POST /drain`` before being aborted.
     drain_timeout_s: float = 30.0
+    # -- observability (PR 5; all off by default = bit-identical legacy ----
+    # -- responses, /stats fields, and heartbeat wire bytes) ---------------
+    #: request tracing: span recorder + W3C traceparent propagation
+    #: (adopted from the ``traceparent`` request header, threaded through
+    #: the engine and the transfer envelope); finished traces served at
+    #: ``GET /debug/traces``.
+    obs_tracing: bool = False
+    #: finished-span ring size for /debug/traces
+    obs_trace_buffer: int = 2048
+    #: latency-decomposition histograms (TTFT/ITL/queue/e2e/pull) +
+    #: engine-step phase timing, batch-occupancy / free-page / loop-lag
+    #: gauges on /metrics, and an ``obs`` block on /stats.
+    obs_metrics: bool = False
+    #: directory for ``POST /debug/profile`` jax.profiler traces; unset =
+    #: the endpoint is disabled.
+    obs_profile_dir: Optional[str] = None
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -386,6 +558,13 @@ class PodServerConfig:
         cfg.drain_timeout_s = float(
             os.environ.get("DRAIN_TIMEOUT_S", cfg.drain_timeout_s)
         )
+        # Observability (0/unset = off, legacy behavior).
+        cfg.obs_tracing = _env_bool("OBS_TRACING", "0")
+        cfg.obs_trace_buffer = int(
+            os.environ.get("OBS_TRACE_BUFFER", cfg.obs_trace_buffer)
+        )
+        cfg.obs_metrics = _env_bool("OBS_METRICS", "0")
+        cfg.obs_profile_dir = os.environ.get("OBS_PROFILE_DIR") or None
 
         eng = cfg.engine
         eng.block_manager = BlockManagerConfig(
@@ -465,6 +644,13 @@ class PodServer:
         self.config = config or PodServerConfig()
         self._tokenizer = tokenizer
         self.transfer_cost_model = transfer_cost_model
+        #: request tracing (OBS_TRACING); a disabled tracer hands out one
+        #: shared no-op span, so the default request path allocates nothing.
+        self.tracer = Tracer(
+            enabled=self.config.obs_tracing,
+            max_spans=self.config.obs_trace_buffer,
+            service=f"pod:{self.config.pod_identifier}",
+        )
 
         self._publisher = publisher
         if self._publisher is None and self.config.publish_events:
@@ -482,16 +668,17 @@ class PodServer:
         if engine is not None and on_events is not None:
             # Injected engine: attach the publisher to its block manager.
             self.engine.block_manager.on_events = on_events
+        if self.config.obs_metrics:
+            self.engine.obs_step_timing = True
 
         #: staging guard — HTTP threads only touch the staging deque; the
         #: engine itself is single-threaded (loop thread only), so steps run
         #: without any lock and enqueueing never waits on device compute.
         self._mu = threading.Lock()
         self._work = threading.Condition(self._mu)
-        #: staged request tuples: (tokens, sampling, deadline, rid, future)
-        self._staging: deque[
-            tuple[list[int], Optional[SamplingParams], Optional[float], str, Future]
-        ] = deque()
+        #: staged request tuples:
+        #: (tokens, sampling, deadline, rid, future, span, route_action)
+        self._staging: deque[tuple] = deque()
         self._futures: dict[int, Future] = {}  # loop-thread-only
         #: staged aborts: (request_id | None = all, future -> bool)
         self._aborts: deque[tuple[Optional[str], Future]] = deque()
@@ -507,10 +694,18 @@ class PodServer:
         self._drain_clean: Optional[bool] = None
         self.drains_started = 0
         self.drain_forced_requests = 0
-        self.metrics = _ServingMetrics()
+        self.metrics = _ServingMetrics(obs=self.config.obs_metrics)
         self._running = False
         self._failed: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
+        #: engine-loop lag EMA (OBS_METRICS): host-side gap between the end
+        #: of one iteration and the start of the next while work was
+        #: pending — the "how far behind the device is the loop" signal.
+        self._loop_lag_s: Optional[float] = None
+        self._loop_prev_end: Optional[float] = None
+        self._loop_had_work = False
+        #: /debug/profile serialization (one capture at a time)
+        self._profile_mu = threading.Lock()
 
         # -- cross-pod KV transfer plane (off unless configured) -----------
         # Export requests and imports stage onto the ENGINE LOOP, the only
@@ -539,6 +734,7 @@ class PodServer:
                     max_blocks=self.config.transfer_max_blocks,
                 ),
                 handler=self._serve_export,
+                tracer=self.tracer,
             )
 
     # -- lifecycle ----------------------------------------------------------
@@ -679,7 +875,9 @@ class PodServer:
             self._digest_requests.clear()
             self._pending = 0
             self._pending_tokens = 0
-        for _, _, _, _, fut in staged:
+        for _, _, _, _, fut, span, _ in staged:
+            span.set_attr("error", str(exc))
+            span.end()
             if not fut.done():
                 fut.set_exception(exc)
         for _, afut in aborts:
@@ -704,11 +902,61 @@ class PodServer:
         """Resolve a finished/aborted sequence's future and release its
         admission accounting (engine loop only)."""
         self.metrics.observe_finished(seq)
+        if seq.trace_span is not None:
+            self._emit_request_spans(seq)
         fut = self._futures.pop(seq.seq_id, None)
         if fut is not None:
             self._forget_pending(seq.user_prompt_len)
             if not fut.done():
                 fut.set_result(seq)
+
+    def _emit_request_spans(self, seq: Sequence) -> None:
+        """End the request span and reconstruct its queue/prefill/decode
+        children from the timestamps the engine already stamps — zero
+        per-token tracing cost; the whole decomposition is derived once at
+        request completion."""
+        span, seq.trace_span = seq.trace_span, None
+        if span.context is None:  # noop span (tracing off)
+            return
+        end = seq.finish_time if seq.finish_time is not None else time.monotonic()
+        if seq.prefill_start_time is not None:
+            self.tracer.record_span(
+                "pod.queue", span, span.start_mono, seq.prefill_start_time
+            )
+            prefill_end = (
+                seq.first_token_time
+                if seq.first_token_time is not None
+                else end
+            )
+            self.tracer.record_span(
+                "pod.prefill",
+                span,
+                seq.prefill_start_time,
+                prefill_end,
+                attrs={
+                    "cached_prompt_tokens": seq.num_cached_prompt,
+                    "prompt_tokens": seq.user_prompt_len,
+                },
+            )
+            if seq.first_token_time is not None and seq.num_generated > 1:
+                self.tracer.record_span(
+                    "pod.decode",
+                    span,
+                    seq.first_token_time,
+                    end,
+                    attrs={"generated_tokens": seq.num_generated},
+                )
+        else:
+            # Never reached prefill (shed/aborted while queued): the whole
+            # life was queueing.
+            self.tracer.record_span("pod.queue", span, span.start_mono, end)
+        outcome, finish = _ServingMetrics.request_labels(seq)
+        span.set_attr("outcome", outcome)
+        span.set_attr("finish", finish)
+        span.set_attr("generated_tokens", seq.num_generated)
+        if seq.error:
+            span.set_attr("error", seq.error)
+        span.end(end_mono=end)
 
     def _engine_loop(self) -> None:
         try:
@@ -756,13 +1004,15 @@ class PodServer:
                         )
                     except Exception as e:
                         fut.set_exception(e)
-                for tokens, sampling, deadline, rid, fut in staged:
+                for tokens, sampling, deadline, rid, fut, span, action in staged:
                     try:
                         seq = self.engine.add_request(
                             tokens, sampling, request_id=rid, deadline=deadline
                         )
                     except ValueError as e:
                         self._forget_pending(len(tokens))
+                        span.set_attr("error", str(e))
+                        span.end()
                         # done() guard: a disconnected client may have
                         # CANCELLED this future already; set_exception on a
                         # cancelled future raises InvalidStateError — which
@@ -770,6 +1020,8 @@ class PodServer:
                         if not fut.done():
                             fut.set_exception(e)
                         continue
+                    seq.trace_span = span if span.context is not None else None
+                    seq.route_action = action
                     self._futures[seq.seq_id] = fut
                 # Aborts AFTER admissions: a submit-then-abort staged in
                 # the same drain cycle must find its sequence in the engine.
@@ -793,6 +1045,19 @@ class PodServer:
                         self.engine.lifecycle_stats
                     )
                 if self.engine.has_work:
+                    obs = self.config.obs_metrics
+                    if obs:
+                        t_start = time.perf_counter()
+                        if self._loop_had_work and self._loop_prev_end is not None:
+                            # Lag only counts gaps while work was pending at
+                            # the previous iteration's end — idle waits are
+                            # not loop lag.
+                            sample = max(t_start - self._loop_prev_end, 0.0)
+                            self._loop_lag_s = (
+                                sample
+                                if self._loop_lag_s is None
+                                else 0.7 * self._loop_lag_s + 0.3 * sample
+                            )
                     finished = self.engine.step()
                     if (
                         self.transfer_cost_model is not None
@@ -807,6 +1072,18 @@ class PodServer:
                     self.metrics.sync_lifecycle_stats(
                         self.engine.lifecycle_stats
                     )
+                    if obs:
+                        self._loop_prev_end = time.perf_counter()
+                        self._loop_had_work = self.engine.has_work
+                        sch = self.engine.scheduler
+                        self.metrics.sync_step_stats(
+                            self.engine.step_stats, self._loop_lag_s
+                        )
+                        self.metrics.set_engine_gauges(
+                            len(sch.running)
+                            / max(self.config.engine.decode_batch_size, 1),
+                            self.engine.block_manager.num_free,
+                        )
                     for seq in finished:
                         self._resolve(seq)
         except Exception as e:  # engine wedged: fail fast and visibly
@@ -939,6 +1216,7 @@ class PodServer:
         source_endpoint: str,
         timeout_s: Optional[float] = None,
         deadline: Optional[float] = None,
+        trace_ctx=None,
     ) -> int:
         """Pull ``prompt_tokens``' warm prefix from a peer pod's export
         service and commit it locally (the router's "pull-then-compute"
@@ -948,21 +1226,42 @@ class PodServer:
         request's deadline): the fetch and import waits are clamped to the
         remaining budget, and a pull with no budget left is skipped
         outright — cold prefill starts immediately instead of burning the
-        deadline on a transfer the client can no longer wait for."""
+        deadline on a transfer the client can no longer wait for.
+        ``trace_ctx``: parent span context — the pull span (and, via the
+        transfer envelope's traceparent, the exporting peer's spans) joins
+        that trace."""
+        span = self.tracer.start_span(
+            "pod.pull_prefix",
+            parent=trace_ctx,
+            attrs={"source": source_endpoint, "pod": self.config.pod_identifier},
+        )
+        t_pull = time.monotonic()
+
+        def done(n: int, outcome: str) -> int:
+            span.set_attr("outcome", outcome)
+            span.set_attr("imported_blocks", n)
+            span.end()
+            self.metrics.observe_pull(time.monotonic() - t_pull, outcome)
+            return n
+
         fetch_timeout: Optional[float] = None  # None = client's configured
         wait_timeout = timeout_s or self.config.transfer_timeout_s * 3
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                return 0
+                # Budget exhausted before the fetch — NOT "peer had
+                # nothing": under deadline pressure this is the overload
+                # signal the decomposition exists to expose.
+                return done(0, "skipped")
             fetch_timeout = min(self.config.transfer_timeout_s, remaining)
             wait_timeout = min(wait_timeout, remaining)
         hashes = self.engine.block_manager.token_db.prefix_hashes(prompt_tokens)
         if not hashes:
-            return 0
+            return done(0, "empty")
         with self._mu:  # pull_prefix races shutdown's client sweep
             if not self._running:
-                return 0  # a client created post-sweep would leak its socket
+                # a client created post-sweep would leak its socket
+                return done(0, "skipped")
             client = self._transfer_clients.get(source_endpoint)
             if client is None:
                 client = KVTransferClient(
@@ -984,6 +1283,11 @@ class PodServer:
                 hashes,
                 self.config.transfer_max_blocks,
                 timeout_s=fetch_timeout,
+                traceparent=(
+                    format_traceparent(span.context)
+                    if span.context is not None
+                    else None
+                ),
             )
             imported = (
                 self.submit_import(blocks).result(timeout=wait_timeout)
@@ -997,10 +1301,11 @@ class PodServer:
                 source=source_endpoint,
                 error=repr(e),
             )
-            return 0
+            span.set_attr("error", repr(e))
+            return done(0, "failed")
         if imported:
             self.transfer_pulls += 1
-        return imported
+        return done(imported, "ok" if imported else "empty")
 
     # -- request path -------------------------------------------------------
     def _retry_after_s(self, depth: int, queued_tokens: int) -> float:
@@ -1059,6 +1364,8 @@ class PodServer:
         *,
         deadline_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        trace_ctx=None,
+        route_action: Optional[str] = None,
     ) -> Future:
         """Enqueue a request; the Future resolves to the finished Sequence
         (or raises: invalid request, engine failure, shutdown). Raises
@@ -1066,7 +1373,12 @@ class PodServer:
         touches the engine) and ``DrainingError`` while draining (503).
         ``deadline_s``: per-request deadline budget in seconds (falls back
         to ``default_deadline_s``; 0/None = none). The returned Future
-        carries ``request_id`` for ``abort``."""
+        carries ``request_id`` for ``abort``. ``trace_ctx`` (an
+        ``obs.SpanContext``, e.g. parsed from a ``traceparent`` header):
+        parent for this request's spans — with tracing enabled the pod
+        mints its own trace when None. ``route_action``: the router's
+        verdict ("route_warm"/"pull"/"cold") labeling the latency
+        histograms; None derives warm/cold from the prefix-cache hit."""
         # Surface obviously-bad requests synchronously with the same checks
         # add_request applies (the rest raise through the Future).
         if not prompt_tokens:
@@ -1081,6 +1393,9 @@ class PodServer:
         rid = request_id or str(uuid.uuid4())
         fut: Future = Future()
         fut.request_id = rid
+        # Span starts at submit (queueing time includes staging), after the
+        # reject paths — a 429/503 is not a served request.
+        span = None
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"engine failed: {self._failed}")
@@ -1093,10 +1408,21 @@ class PodServer:
                     "pod is draining; retry against another pod"
                 )
             self._check_admission(len(prompt_tokens))
+            span = self.tracer.start_span(
+                "pod.request",
+                parent=trace_ctx,
+                attrs={
+                    "request_id": rid,
+                    "pod": self.config.pod_identifier,
+                    "prompt_tokens": len(prompt_tokens),
+                },
+            )
+            fut.trace_context = span.context
             self._pending += 1
             self._pending_tokens += len(prompt_tokens)
             self._staging.append(
-                (list(prompt_tokens), sampling, deadline, rid, fut)
+                (list(prompt_tokens), sampling, deadline, rid, fut, span,
+                 route_action)
             )
             self._work.notify()
         return fut
@@ -1196,8 +1522,24 @@ class PodServer:
                         {"error": "invalid X-Request-Deadline (want seconds > 0)"},
                         status=400,
                     )
+            # W3C trace propagation: adopt the caller's traceparent (the
+            # scoring service / router minted it) so this pod's spans join
+            # the request's fleet-wide trace. Parsed only when tracing is
+            # on — the off path reads no headers it didn't before.
+            trace_ctx = None
+            if self.tracer.enabled:
+                trace_ctx = parse_traceparent(request.headers.get("traceparent"))
+            route_action = request.headers.get("X-Route-Action")
+            if route_action not in ("route_warm", "pull", "cold"):
+                route_action = None
             try:
-                fut = self.submit(token_ids, sampling, deadline_s=deadline_s)
+                fut = self.submit(
+                    token_ids,
+                    sampling,
+                    deadline_s=deadline_s,
+                    trace_ctx=trace_ctx,
+                    route_action=route_action,
+                )
             except AdmissionError as e:  # overloaded: fast 429, engine untouched
                 retry_after = max(int(-(-e.retry_after_s // 1)), 1)
                 return web.json_response(
@@ -1211,18 +1553,24 @@ class PodServer:
                 return web.json_response({"error": str(e)}, status=400)
             except RuntimeError as e:  # engine failure / shutdown
                 return web.json_response({"error": str(e)}, status=503)
-            try:
-                seq = await asyncio.wrap_future(fut)
-            except asyncio.CancelledError:
-                # Client disconnected (or the handler was cancelled): abort
-                # the sequence instead of decoding into the void — its
-                # pages free as soon as the engine loop picks the abort up.
-                self.abort(fut.request_id)
-                raise
-            except ValueError as e:  # rejected by engine admission checks
-                return web.json_response({"error": str(e)}, status=400)
-            except RuntimeError as e:  # engine failure / shutdown / drain
-                return web.json_response({"error": str(e)}, status=503)
+            ctx = getattr(fut, "trace_context", None)
+            with log_context(
+                request_id=fut.request_id,
+                trace_id=ctx.trace_id if ctx is not None else None,
+            ):
+                try:
+                    seq = await asyncio.wrap_future(fut)
+                except asyncio.CancelledError:
+                    # Client disconnected (or the handler was cancelled):
+                    # abort the sequence instead of decoding into the void —
+                    # its pages free as soon as the engine loop picks the
+                    # abort up.
+                    self.abort(fut.request_id)
+                    raise
+                except ValueError as e:  # rejected by engine admission checks
+                    return web.json_response({"error": str(e)}, status=400)
+                except RuntimeError as e:  # engine failure / shutdown / drain
+                    return web.json_response({"error": str(e)}, status=503)
             if seq.error:
                 return web.json_response({"error": seq.error}, status=500)
 
@@ -1240,6 +1588,13 @@ class PodServer:
             stopped = bool(out_tokens) and out_tokens[-1] in sampling.stop_token_ids
             finish_reason = seq.finish_reason or (
                 "stop" if stopped else "length"
+            )
+            # traceparent echo ONLY when tracing is on: with knobs off the
+            # response (body AND headers) is bit-identical legacy.
+            headers = (
+                {"traceparent": format_traceparent(ctx)}
+                if ctx is not None
+                else None
             )
             return web.json_response(
                 {
@@ -1260,7 +1615,8 @@ class PodServer:
                         "cached_prompt_tokens": seq.num_cached_prompt,
                     },
                     "ttft_s": seq.ttft,
-                }
+                },
+                headers=headers,
             )
 
         async def healthz(_request: web.Request) -> web.Response:
@@ -1353,6 +1709,17 @@ class PodServer:
                     "forced_requests": self.drain_forced_requests,
                 },
             }
+            if self.config.obs_tracing or self.config.obs_metrics:
+                # Only with an OBS_* knob on: the knobs-off /stats payload
+                # stays bit-identical to previous rounds.
+                payload["obs"] = {
+                    "tracing": self.tracer.snapshot(),
+                    "step_stats": {
+                        k: round(v, 6) if isinstance(v, float) else v
+                        for k, v in self.engine.step_stats.items()
+                    },
+                    "loop_lag_s": self._loop_lag_s,
+                }
             return web.json_response(payload)
 
         async def metrics(_request: web.Request) -> web.Response:
@@ -1363,12 +1730,84 @@ class PodServer:
                 )
             return web.Response(body=body, content_type="text/plain")
 
+        async def debug_traces(request: web.Request) -> web.Response:
+            """Finished traces from the bounded ring, filterable by
+            ``?trace_id=`` / ``?request_id=``. Empty (with enabled=false)
+            when OBS_TRACING is off — the endpoint itself is harmless."""
+            from ..obs.tracing import debug_traces_payload
+
+            status, payload = debug_traces_payload(self.tracer, request.query)
+            return web.json_response(payload, status=status)
+
+        async def debug_profile(request: web.Request) -> web.Response:
+            """Capture a jax.profiler trace of the live engine for
+            ``?seconds=N`` (default 3, capped at 60) into
+            ``OBS_PROFILE_DIR``. Disabled (400) until that knob is set;
+            one capture at a time."""
+            import asyncio
+
+            profile_dir = self.config.obs_profile_dir
+            if not profile_dir:
+                return web.json_response(
+                    {"error": "profiling disabled; set OBS_PROFILE_DIR"},
+                    status=400,
+                )
+            try:
+                seconds = float(request.query.get("seconds", "3"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "invalid seconds"}, status=400
+                )
+            if not (0 < seconds <= 60):
+                return web.json_response(
+                    {"error": "seconds must be in (0, 60]"}, status=400
+                )
+            if not self._profile_mu.acquire(blocking=False):
+                return web.json_response(
+                    {"error": "a profile capture is already running"},
+                    status=409,
+                )
+
+            def capture() -> None:
+                # The lock is released HERE, not in the handler: a client
+                # disconnect cancels the awaiting handler, but executor
+                # work is uncancellable — releasing from the handler would
+                # let a second capture collide with the still-running
+                # profiler (start_trace raises while one is active).
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(profile_dir)
+                    try:
+                        time.sleep(seconds)
+                    finally:
+                        jax.profiler.stop_trace()
+                finally:
+                    self._profile_mu.release()
+
+            try:
+                fut = asyncio.get_running_loop().run_in_executor(None, capture)
+            except RuntimeError:
+                self._profile_mu.release()  # never dispatched
+                raise
+            try:
+                await fut
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"profile capture failed: {e!r}"}, status=500
+                )
+            return web.json_response(
+                {"profile_dir": profile_dir, "seconds": seconds}
+            )
+
         app = web.Application()
         app.router.add_post("/v1/completions", completions)
         app.router.add_get("/healthz", healthz)
         app.router.add_post("/drain", drain_endpoint)
         app.router.add_get("/stats", stats)
         app.router.add_get("/metrics", metrics)
+        app.router.add_get("/debug/traces", debug_traces)
+        app.router.add_post("/debug/profile", debug_profile)
         return app
 
 

@@ -1,3 +1,3 @@
-from .logging import get_logger, DEBUG, TRACE
+from .logging import get_logger, log_context, DEBUG, TRACE
 
-__all__ = ["get_logger", "DEBUG", "TRACE"]
+__all__ = ["get_logger", "log_context", "DEBUG", "TRACE"]

@@ -8,8 +8,32 @@ be enabled independently of ordinary debug output.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import logging
 import os
+
+#: request-scoped structured-log context: {"request_id": ..., "trace_id":
+#: ...} injected into every record emitted inside a ``log_context`` block,
+#: so one request's pod logs grep end to end by id. Contextvars propagate
+#: through asyncio tasks and thread-pool executors started inside the
+#: context; the engine loop sets its own context around per-request work.
+_LOG_CTX: contextvars.ContextVar[dict] = contextvars.ContextVar(
+    "kvcache_log_ctx", default={}
+)
+
+
+@contextlib.contextmanager
+def log_context(**kv):
+    """Attach key-values (e.g. ``request_id=...``, ``trace_id=...``) to
+    every structured log record emitted within the block. Nests: inner
+    contexts extend (and may override) outer ones."""
+    current = _LOG_CTX.get()
+    token = _LOG_CTX.set({**current, **{k: v for k, v in kv.items() if v is not None}})
+    try:
+        yield
+    finally:
+        _LOG_CTX.reset(token)
 
 # klog-style verbosity levels, mapped into stdlib numeric levels.
 # stdlib DEBUG is 10; we give TRACE a lower number so it is *more* verbose.
@@ -59,6 +83,9 @@ class _KVLogger(logging.LoggerAdapter):
 
     def process(self, msg, kwargs):
         kv = {k: kwargs.pop(k) for k in list(kwargs) if k not in self._RESERVED}
+        ctx = _LOG_CTX.get()
+        if ctx:
+            kv = {**ctx, **kv}  # explicit call kwargs win over context
         if kv:
             msg = f"{msg} | " + " ".join(f"{k}={v!r}" for k, v in kv.items())
         return msg, kwargs

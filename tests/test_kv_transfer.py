@@ -117,9 +117,9 @@ def _fake_block(h, parent, token_ids, shape=(2, PS, 2, 8), dtype="float32"):
 class TestProtocol:
     def test_request_round_trip(self):
         payload = encode_request("m", [1, 2, 2**64 - 1], 8)
-        assert decode_request(payload) == ("m", [1, 2, 2**64 - 1], 8)
+        assert decode_request(payload) == ("m", [1, 2, 2**64 - 1], 8, None)
         payload = encode_request("m", [7])
-        assert decode_request(payload) == ("m", [7], None)
+        assert decode_request(payload) == ("m", [7], None, None)
 
     def test_response_round_trip(self):
         blocks = [_fake_block(11, None, range(PS)), _fake_block(12, 11, range(PS))]
