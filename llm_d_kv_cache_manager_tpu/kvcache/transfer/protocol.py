@@ -10,7 +10,12 @@ raising — a poison request must never kill the export service).
   exporting peer's spans to the puller's trace — appended ONLY when
   tracing is on, so default wire bytes are unchanged)
 - response: ``["Blocks", complete, [[hash, parent_hash, token_ids,
-  block_size, dtype, shape, k_data, v_data], ...]]``
+  block_size, dtype, shape, k_data, v_data, quant?, k_scale?,
+  v_scale?], ...]]`` (the optional trailing triple carries int8-KV
+  compression — ``quant`` names the scheme, the scales are raw f32
+  bytes of ``models/quant.kv_scale_shape``; appended ONLY when the
+  exporter quantizes, so legacy wire bytes are unchanged and old
+  importers, positional and tolerant, simply ignore it)
 - error: ``["TransferError", message]``
 
 Hashes are uint64 (the sha256-CBOR chain the whole system keys on); page
@@ -44,10 +49,22 @@ class BlockPayload:
     shape: tuple[int, ...]
     k_data: bytes
     v_data: bytes
+    #: KV compression scheme ("int8") — None = full-width ``dtype`` bytes.
+    #: ``dtype``/``shape`` stay the LOGICAL page geometry either way; with
+    #: quant set, ``k_data``/``v_data`` are int8 bytes of that shape and
+    #: the scales are raw f32 bytes of ``models/quant.kv_scale_shape``.
+    quant: Optional[str] = None
+    k_scale: bytes = b""
+    v_scale: bytes = b""
 
     @property
     def wire_bytes(self) -> int:
-        return len(self.k_data) + len(self.v_data)
+        return (
+            len(self.k_data)
+            + len(self.v_data)
+            + len(self.k_scale)
+            + len(self.v_scale)
+        )
 
 
 def encode_request(
@@ -104,24 +121,26 @@ def decode_request(
 
 
 def encode_response(blocks: Sequence[BlockPayload], complete: bool) -> bytes:
-    arr = [
-        BLOCKS_TAG,
-        bool(complete),
-        [
-            [
-                b.block_hash,
-                b.parent_block_hash,
-                list(b.token_ids),
-                b.block_size,
-                b.dtype,
-                list(b.shape),
-                b.k_data,
-                b.v_data,
-            ]
-            for b in blocks
-        ],
-    ]
-    return msgpack.packb(arr, use_bin_type=True)
+    encoded = []
+    for b in blocks:
+        raw: list = [
+            b.block_hash,
+            b.parent_block_hash,
+            list(b.token_ids),
+            b.block_size,
+            b.dtype,
+            list(b.shape),
+            b.k_data,
+            b.v_data,
+        ]
+        if b.quant is not None:
+            # Trailing optional triple: only on the wire for quantized
+            # blocks, so unquantized response bytes stay bit-identical.
+            raw.extend([b.quant, b.k_scale, b.v_scale])
+        encoded.append(raw)
+    return msgpack.packb(
+        [BLOCKS_TAG, bool(complete), encoded], use_bin_type=True
+    )
 
 
 def encode_error(message: str) -> bytes:
@@ -158,6 +177,16 @@ def _decode_block(raw: Any) -> Optional[BlockPayload]:
         v_data, (bytes, bytearray)
     ):
         return None
+    # Optional trailing quant triple (int8 KV): absent on legacy frames.
+    quant = _text(raw[8]) if len(raw) > 8 else None
+    if quant is not None and not isinstance(quant, str):
+        return None  # a malformed scheme tag corrupts the payload meaning
+    k_scale = raw[9] if len(raw) > 9 else b""
+    v_scale = raw[10] if len(raw) > 10 else b""
+    if not isinstance(k_scale, (bytes, bytearray)) or not isinstance(
+        v_scale, (bytes, bytearray)
+    ):
+        return None
     try:
         return BlockPayload(
             block_hash=int(h),
@@ -168,6 +197,9 @@ def _decode_block(raw: Any) -> Optional[BlockPayload]:
             shape=tuple(int(d) for d in (shape or ())),
             k_data=bytes(k_data),
             v_data=bytes(v_data),
+            quant=quant,
+            k_scale=bytes(k_scale),
+            v_scale=bytes(v_scale),
         )
     except (TypeError, ValueError):
         return None

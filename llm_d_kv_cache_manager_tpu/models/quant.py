@@ -32,6 +32,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 #: Parameter names eligible for quantization (matmul weights only).
 QUANTIZABLE = frozenset(
@@ -116,6 +117,47 @@ def quantize_params(
         return out
 
     return convert(params)
+
+
+# -- paged-KV quantization (host-DRAM tier + transfer wire) -----------------
+#
+# Symmetric per-page-per-head int8 for KV page slices of shape
+# ``[n_layers, page_size, n_kv_heads, head_dim]``. One scale per
+# (layer, kv_head) per page — coarse enough that scales are noise on the
+# wire (n_layers * n_kv_heads f32 vs page_size * head_dim int8 payload),
+# fine enough that an outlier head cannot poison the whole page's
+# resolution. Deliberately numpy, not jax: both call sites (host-tier
+# spill/restore and the transfer wire) already live on the host side of
+# the batched-mover fence, so quantizing there adds zero device work and
+# the Pallas paged-attention path never sees an int8 page.
+
+#: modes accepted by the ``KV_QUANT`` knob
+KV_QUANT_MODES = ("int8",)
+
+
+def kv_scale_shape(page_shape: tuple[int, ...]) -> tuple[int, ...]:
+    """Scale array shape for one quantized KV page slice."""
+    n_layers, _, n_kv_heads, _ = page_shape
+    return (n_layers, 1, n_kv_heads, 1)
+
+
+def quantize_kv_page(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Quantize one KV page slice ``[n_layers, page_size, n_kv_heads, hd]``
+    to int8 with per-(layer, kv_head) symmetric f32 scales. Error per
+    element is bounded by ``scale / 2``; zeros round-trip exactly."""
+    x32 = np.asarray(x, np.float32)
+    amax = np.max(np.abs(x32), axis=(1, 3), keepdims=True)
+    scale = np.maximum(amax, 1e-8).astype(np.float32) / 127.0
+    q = np.clip(np.rint(x32 / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_kv_page(
+    q: np.ndarray, scale: np.ndarray, dtype: Any
+) -> np.ndarray:
+    """Inverse of :func:`quantize_kv_page` into ``dtype`` (the engine's KV
+    pool dtype — pages re-enter the paged-attention path full-width)."""
+    return (q.astype(np.float32) * np.asarray(scale, np.float32)).astype(dtype)
 
 
 def is_quantized(params: Any) -> bool:

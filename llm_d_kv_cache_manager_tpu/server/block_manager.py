@@ -91,6 +91,18 @@ class BlockManager:
         self._host_cached: dict[int, int] = {}  # chain_hash -> host slot
         self._host_info: dict[int, _PageInfo] = {}  # host slot -> metadata
         self._host_lru: OrderedDict[int, None] = OrderedDict()  # host slots
+        #: host-tier accounting (monotone; /stats + kvcache_host_* feed):
+        #: spilled/restored = device↔host page moves, prefetched = the
+        #: subset of restores issued AHEAD of allocate by the prefetch
+        #: stage, host_evicted = host-LRU drops, spill_declined = spills
+        #: the recompute-vs-restore cost model refused.
+        self.host_stats = {
+            "spilled": 0,
+            "restored": 0,
+            "prefetched": 0,
+            "host_evicted": 0,
+            "spill_declined": 0,
+        }
 
     def attach_host_pool(self, copy_out, copy_in, restore_policy=None) -> None:
         """Install the engine's device↔host page movers, enabling the
@@ -121,6 +133,7 @@ class BlockManager:
         slot, _ = self._host_lru.popitem(last=False)
         info = self._host_info.pop(slot)
         del self._host_cached[info.chain_hash]
+        self.host_stats["host_evicted"] += 1
         self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="host_dram"))
         return slot
 
@@ -140,10 +153,12 @@ class BlockManager:
         # tiering.md round 5). Optimistic until both rates have samples,
         # so the model can bootstrap from real early spills+restores.
         if self._restore_policy is not None and not self._restore_policy(1):
+            self.host_stats["spill_declined"] += 1
             return
         slot = self._host_alloc_slot()
         if slot is None:
             return
+        self.host_stats["spilled"] += 1
         self._copy_out(page, slot)
         self._host_cached[info.chain_hash] = slot
         self._host_info[slot] = info
@@ -241,6 +256,7 @@ class BlockManager:
             return None
         self._copy_in(slot, page)
         self._host_free.append(slot)
+        self.host_stats["restored"] += 1
         info.ref_count = 0
         self._pages[page] = info
         self._cached[h] = page
@@ -256,6 +272,46 @@ class BlockManager:
             )
         )
         return page
+
+    def prefetch_chain(self, hashes: Seq[int], max_pages: int) -> int:
+        """Bring host-cached blocks of a prefix chain back into HBM AHEAD
+        of allocate (the prefetch stage): walks ``hashes`` like ``allocate``
+        does, restoring up to ``max_pages`` host hits into ref-0 evictable
+        HBM pages so the device↔host copies overlap the current step and
+        the later ``allocate`` sees plain warm pages. HBM-resident chain
+        pages are touched to MRU while walking — a prefetch must never
+        recycle an earlier page of the very chain it is warming. Restores
+        respect the recompute-vs-restore cost model with the same
+        run-at-a-time consultation as ``allocate`` (a declined run stops
+        the walk: allocate will stop there too). Returns pages restored."""
+        restored = 0
+        restore_until = -1
+        for i, h in enumerate(hashes):
+            page = self._cached.get(h)
+            if page is not None:
+                if page in self._evictable:
+                    self._evictable.move_to_end(page)
+                continue
+            if h not in self._host_cached:
+                break
+            if restored >= max_pages:
+                break
+            if self._restore_policy is not None and i > restore_until:
+                run = 0
+                while (
+                    i + run < len(hashes)
+                    and hashes[i + run] in self._host_cached
+                ):
+                    run += 1
+                if not self._restore_policy(run):
+                    break
+                restore_until = i + run - 1
+            if self._try_restore(h) is None:
+                break  # no HBM page available: stop, allocate will block
+            restored += 1
+        if restored:
+            self.host_stats["prefetched"] += restored
+        return restored
 
     # -- fleet self-healing (kvcache/kvevents resync) -----------------------
     def block_digest(self) -> dict[str, list[int]]:

@@ -19,6 +19,7 @@ lane count), so steady-state serving replays cached executables.
 from __future__ import annotations
 
 import functools
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -28,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..kvcache.kvevents.events import Event
-from ..models import llama
+from ..models import llama, quant
 from ..models.llama import LlamaConfig
 from ..utils import get_logger
 from .block_manager import AllocationError, BlockManager, BlockManagerConfig
@@ -155,6 +156,19 @@ class EngineConfig:
     #: self-calibrating default) or "always" (unconditional spill/restore;
     #: use when the link is known-good and warm-up declines are unwanted).
     host_tier_policy: str = "auto"
+    #: paged-KV quantization for the host-DRAM tier and the transfer wire:
+    #: None (full-width pages everywhere, bit-identical legacy) or "int8"
+    #: (symmetric per-page-per-head int8, models/quant.quantize_kv_page —
+    #: halves host-tier bytes per page and transfer wire bytes, so the
+    #: same host budget holds 2x the blocks). Pages are dequantized on
+    #: bring-back/import BEFORE re-entering the Pallas paged-attention
+    #: path; the device-side kernels never see an int8 page.
+    kv_quant: Optional[str] = None
+    #: host-tier prefetch: bring a waiting sequence's host-cached prefix
+    #: back into HBM ahead of the scheduler (device↔host copies overlap
+    #: the current step) instead of restoring synchronously inside
+    #: allocate. Off by default = bit-identical legacy scheduling.
+    host_prefetch: bool = False
     #: weight quantization: None (serve in model dtype) or "int8"
     #: (symmetric per-output-channel weight-only int8 — halves weight HBM
     #: bytes so 8B-class models fit one v5e chip with a KV pool;
@@ -228,8 +242,6 @@ class Engine:
                 quantize_experts=config.quantize_experts,
             )
         elif config.quantize is not None:
-            from ..models import quant
-
             if config.quantize != "int8":
                 raise ValueError(f"unknown quantize mode {config.quantize!r}")
             if not quant.is_quantized(params):
@@ -311,12 +323,25 @@ class Engine:
         self._offload_rate: Optional[float] = None  # D2H gathered pages / s
 
         # Host-DRAM offload tier: numpy slot pool + jitted page movers.
+        # With kv_quant="int8" the slot pool is int8 + per-(layer, head)
+        # f32 scales — half the bytes per page of a bf16 pool, so a fixed
+        # host-DRAM budget holds ~2x the blocks.
+        if config.kv_quant is not None:
+            if config.kv_quant not in quant.KV_QUANT_MODES:
+                raise ValueError(f"unknown kv_quant mode {config.kv_quant!r}")
         hp = config.block_manager.host_pages
         if hp > 0:
             slot_shape = (hp, cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
             np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
-            self._host_k = np.zeros(slot_shape, np_dtype)
-            self._host_v = np.zeros(slot_shape, np_dtype)
+            if config.kv_quant == "int8":
+                self._host_k = np.zeros(slot_shape, np.int8)
+                self._host_v = np.zeros(slot_shape, np.int8)
+                sc_shape = (hp,) + quant.kv_scale_shape(slot_shape[1:])
+                self._host_k_scale = np.zeros(sc_shape, np.float32)
+                self._host_v_scale = np.zeros(sc_shape, np.float32)
+            else:
+                self._host_k = np.zeros(slot_shape, np_dtype)
+                self._host_v = np.zeros(slot_shape, np_dtype)
             if config.host_tier_policy not in ("auto", "always"):
                 raise ValueError(
                     f"unknown host_tier_policy {config.host_tier_policy!r}"
@@ -367,6 +392,22 @@ class Engine:
         self._pending_restores: list = []
         self._off_by_slot: dict = {}
         self._restore_by_page: dict = {}
+        #: host-tier prefetch observability (host_prefetch knob): rounds =
+        #: steps where the stage ran and found work, pages = host blocks
+        #: brought back ahead of allocate, seqs = waiting sequences whose
+        #: chains were warmed.
+        self.host_prefetch_stats = {"rounds": 0, "pages": 0, "seqs": 0}
+        #: (pages, start_mono, end_mono) of the most recent prefetch round
+        #: that moved pages — the serving layer turns it into a
+        #: ``pod.host_bringback`` span + prefetch-seconds sample, then
+        #: clears it. Engine-internal timing stays off the default path.
+        self.last_prefetch: Optional[tuple[int, float, float]] = None
+        #: per-step prefetch page cap: one prefill batch's worth of pages,
+        #: so the bring-back gather stays the same order of work as the
+        #: prefill dispatch it overlaps.
+        self._prefetch_page_cap = max(
+            1, config.scheduler.max_prefill_tokens // ps
+        )
         self._rng = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self.finished: list[Sequence] = []
         self._step_count = 0
@@ -422,10 +463,28 @@ class Engine:
         self._pending_offloads.append((slot, src))
         self._off_by_slot[slot] = src
 
+    def _read_host_slot(self, slot: int) -> tuple[np.ndarray, np.ndarray]:
+        """One host slot's KV as full-width model-dtype arrays (dequantized
+        when the tier is int8), snapshotted so they outlive slot reuse —
+        the restore scatter's source. (Exports read the slot pools
+        directly: quantized wire ships the stored codes, and tobytes()
+        needs no snapshot.)"""
+        if self.config.kv_quant == "int8":
+            np_dtype = np.dtype(jnp.dtype(self.model_cfg.dtype).name)
+            return (
+                quant.dequantize_kv_page(
+                    self._host_k[slot], self._host_k_scale[slot], np_dtype
+                ),
+                quant.dequantize_kv_page(
+                    self._host_v[slot], self._host_v_scale[slot], np_dtype
+                ),
+            )
+        return self._host_k[slot].copy(), self._host_v[slot].copy()
+
     def _restore_page(self, slot: int, page: int) -> None:
         src = self._off_by_slot.get(slot)
         if src is None:
-            src = ("data", self._host_k[slot].copy(), self._host_v[slot].copy())
+            src = ("data",) + self._read_host_slot(slot)
         self._pending_restores.append((page, src))
         self._restore_by_page[page] = src
 
@@ -453,6 +512,46 @@ class Engine:
         restore_s = n_pages / tier_rate
         recompute_s = n_pages * self.page_size / self._prefill_rate
         return restore_s <= recompute_s
+
+    def _prefetch_host_pages(self) -> None:
+        """Prefetch stage: walk the first prefill batch's worth of WAITING
+        sequences in FCFS order and bring their host-cached prefix chains
+        back into HBM (ref-0 evictable pages, data queued through the
+        batched movers) so the scheduler's later ``allocate`` sees plain
+        warm pages. Bounded per step by ``_prefetch_page_cap``; the
+        recompute-vs-restore cost model gates every run exactly as the
+        blocking path would, so outputs are identical with the knob off."""
+        bm = self.block_manager
+        if bm.num_host_cached_pages == 0 or not self.scheduler.waiting:
+            return
+        budget = self._prefetch_page_cap
+        # islice, not list()[:n]: this runs every step and the waiting
+        # deque can be hundreds deep under the pressure regime.
+        head = list(
+            itertools.islice(
+                self.scheduler.waiting, self.config.scheduler.max_prefill_batch
+            )
+        )
+        pages = 0
+        seqs = 0
+        t0 = time.monotonic()
+        for seq in head:
+            if budget <= 0:
+                break
+            if seq.prefetch_hashes is None:
+                seq.prefetch_hashes = bm.token_db.prefix_hashes(
+                    seq.prompt_tokens
+                )
+            n = bm.prefetch_chain(seq.prefetch_hashes, budget)
+            if n:
+                pages += n
+                seqs += 1
+                budget -= n
+        if pages:
+            self.host_prefetch_stats["rounds"] += 1
+            self.host_prefetch_stats["pages"] += pages
+            self.host_prefetch_stats["seqs"] += seqs
+            self.last_prefetch = (pages, t0, time.monotonic())
 
     def _flush_page_moves(self) -> None:
         if not self._pending_offloads and not self._pending_restores:
@@ -488,8 +587,18 @@ class Engine:
         def resolve(src):
             return page_data[src[1]] if src[0] == "page" else (src[1], src[2])
 
-        for slot, src in self._pending_offloads:
-            self._host_k[slot], self._host_v[slot] = resolve(src)
+        if self.config.kv_quant == "int8":
+            for slot, src in self._pending_offloads:
+                kd, vd = resolve(src)
+                self._host_k[slot], self._host_k_scale[slot] = (
+                    quant.quantize_kv_page(kd)
+                )
+                self._host_v[slot], self._host_v_scale[slot] = (
+                    quant.quantize_kv_page(vd)
+                )
+        else:
+            for slot, src in self._pending_offloads:
+                self._host_k[slot], self._host_v[slot] = resolve(src)
 
         if self._pending_restores:
             # Rate window starts HERE: a mixed flush must not charge the
@@ -536,10 +645,16 @@ class Engine:
     @property
     def kv_block_bytes(self) -> int:
         """Wire bytes of one transferred KV block (k + v page slices) —
-        the ``block_bytes`` feed of the router's transfer cost model."""
+        the ``block_bytes`` feed of the router's transfer cost model. With
+        ``kv_quant="int8"`` this is the int8 payload plus scales: the
+        measured transfer rate is learned from real (quantized) wire
+        bytes, so a full-width figure here would overestimate pull cost
+        ~2x and wrongly decline break-even pulls."""
         cfg = self.model_cfg
-        itemsize = jnp.dtype(cfg.dtype).itemsize
-        return 2 * cfg.n_layers * self.page_size * cfg.n_kv_heads * cfg.hd * itemsize
+        elems = cfg.n_layers * self.page_size * cfg.n_kv_heads * cfg.hd
+        if self.config.kv_quant == "int8":
+            return 2 * (elems + cfg.n_layers * cfg.n_kv_heads * 4)
+        return 2 * elems * jnp.dtype(cfg.dtype).itemsize
 
     def export_kv_blocks(self, hashes: list, max_blocks: Optional[int] = None):
         """Serve a peer's prefix fetch: the longest consecutive resident
@@ -567,12 +682,41 @@ class Engine:
             v = np.asarray(_read_pages_batch(self.v_pages, idx))
             for j, (i, _) in enumerate(dev):
                 page_data[i] = (k[:, j], v[:, j])
+        quantize_wire = self.config.kv_quant == "int8"
+        np_dtype = np.dtype(jnp.dtype(self.model_cfg.dtype).name)
         blocks = []
         for i, (h, info, tier, idx) in enumerate(chain):
+            # Halved wire bytes under kv_quant: ship int8 + f32 scales;
+            # dtype/shape stay the LOGICAL page geometry so the importer's
+            # checks are scheme-independent. Host-tier blocks already
+            # store exactly the int8 codes + scales the wire wants — ship
+            # them directly (no dequant/requant round trip); HBM blocks
+            # quantize from the gathered full-width pages.
+            extra = {}
+            qshape: tuple
             if tier == "tpu_hbm":
                 kd, vd = page_data[i]
+                qshape = tuple(kd.shape)
+                if quantize_wire:
+                    kd, sk = quant.quantize_kv_page(kd)
+                    vd, sv = quant.quantize_kv_page(vd)
+                    extra = {
+                        "quant": "int8",
+                        "k_scale": sk.tobytes(),
+                        "v_scale": sv.tobytes(),
+                    }
             else:
+                # Views into the slot pools; tobytes() below materializes
+                # C-order bytes without a staging copy.
                 kd, vd = self._host_k[idx], self._host_v[idx]
+                qshape = tuple(kd.shape)
+                if quantize_wire:
+                    extra = {
+                        "quant": "int8",
+                        "k_scale": self._host_k_scale[idx].tobytes(),
+                        "v_scale": self._host_v_scale[idx].tobytes(),
+                    }
+            dtype_s = str(np_dtype) if quantize_wire else str(kd.dtype)
             # tobytes() emits C-order bytes from any view — no
             # ascontiguousarray staging copy.
             blocks.append(
@@ -581,10 +725,11 @@ class Engine:
                     parent_block_hash=info.parent_hash,
                     token_ids=list(info.token_ids),
                     block_size=self.page_size,
-                    dtype=str(kd.dtype),
-                    shape=tuple(kd.shape),
+                    dtype=dtype_s,
+                    shape=qshape,
                     k_data=kd.tobytes(),
                     v_data=vd.tobytes(),
+                    **extra,
                 )
             )
         self.transfer_stats["exported_blocks"] += len(blocks)
@@ -611,19 +756,38 @@ class Engine:
         expected_shape = (cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
         np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
         page_bytes = int(np.prod(expected_shape)) * np_dtype.itemsize
+        # Quantized frames ship int8 payloads + f32 scales of the page's
+        # logical shape; any peer's quantized export is importable
+        # regardless of this engine's own kv_quant knob (dequantized
+        # before the page pool ever sees it).
+        q_page_bytes = int(np.prod(expected_shape))
+        scale_bytes = int(np.prod(quant.kv_scale_shape(expected_shape))) * 4
         installed = 0
         for blk in blocks:
             try:
                 blk_dtype = np.dtype(blk.dtype)
             except TypeError:
                 blk_dtype = None
+            quantized = blk.quant is not None
+            if quantized:
+                payload_ok = (
+                    blk.quant == "int8"
+                    and len(blk.k_data) == q_page_bytes
+                    and len(blk.v_data) == q_page_bytes
+                    and len(blk.k_scale) == scale_bytes
+                    and len(blk.v_scale) == scale_bytes
+                )
+            else:
+                payload_ok = (
+                    len(blk.k_data) == page_bytes
+                    and len(blk.v_data) == page_bytes
+                )
             if (
                 blk.block_size != ps
                 or tuple(blk.shape) != expected_shape
                 or blk_dtype != np_dtype
                 or len(blk.token_ids) != ps
-                or len(blk.k_data) != page_bytes
-                or len(blk.v_data) != page_bytes
+                or not payload_ok
             ):
                 self.transfer_stats["import_rejected"] += 1
                 break  # geometry mismatch: nothing later can be valid either
@@ -654,8 +818,21 @@ class Engine:
                 break  # pool full: keep what landed, never evict for imports
             if page is None:
                 continue
-            k = np.frombuffer(blk.k_data, dtype=np_dtype).reshape(expected_shape)
-            v = np.frombuffer(blk.v_data, dtype=np_dtype).reshape(expected_shape)
+            if quantized:
+                sc_shape = quant.kv_scale_shape(expected_shape)
+                k = quant.dequantize_kv_page(
+                    np.frombuffer(blk.k_data, np.int8).reshape(expected_shape),
+                    np.frombuffer(blk.k_scale, np.float32).reshape(sc_shape),
+                    np_dtype,
+                )
+                v = quant.dequantize_kv_page(
+                    np.frombuffer(blk.v_data, np.int8).reshape(expected_shape),
+                    np.frombuffer(blk.v_scale, np.float32).reshape(sc_shape),
+                    np_dtype,
+                )
+            else:
+                k = np.frombuffer(blk.k_data, dtype=np_dtype).reshape(expected_shape)
+                v = np.frombuffer(blk.v_data, dtype=np_dtype).reshape(expected_shape)
             src = ("data", k, v)
             self._pending_restores.append((page, src))
             self._restore_by_page[page] = src
@@ -800,6 +977,12 @@ class Engine:
                 seq.finish_time = now
                 self.lifecycle_stats["deadline_shed"] += 1
                 self.finished.append(seq)
+        if self.config.host_prefetch and self.config.block_manager.host_pages:
+            # Host-tier prefetch AHEAD of the scheduler: waiting sequences'
+            # host-cached prefixes start their device↔host copies now, so
+            # they batch into this step's flush (overlapping the dispatch)
+            # instead of blocking inside a later allocate.
+            self._prefetch_host_pages()
         out = self.scheduler.schedule()
         if timed:
             t1 = time.perf_counter()

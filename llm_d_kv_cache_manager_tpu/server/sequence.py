@@ -85,6 +85,10 @@ class Sequence:
     #: per-sequence gate; survives preemption with the sequence)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    #: memoized prompt prefix-chain hashes for the host-tier prefetch
+    #: stage (hashing is O(prompt) sha256 work; a sequence may wait many
+    #: steps). Invalidated when preemption folds output into the prompt.
+    prefetch_hashes: Optional[list[int]] = None
 
     def __post_init__(self):
         if self.user_prompt_len < 0:
@@ -122,6 +126,7 @@ class Sequence:
         re-prefill will cache-hit the pages that survived eviction."""
         self.prompt_tokens = self.all_tokens
         self.output_tokens = []
+        self.prefetch_hashes = None  # prompt changed: memo is stale
         self.reset_allocation()
         self.status = SequenceStatus.WAITING
 

@@ -18,7 +18,8 @@ Config comes from env vars mirroring the reference's online service
 (``examples/kv_events/online/main.go:162-209``): ``MODEL_NAME``,
 ``POD_IDENTIFIER``, ``ZMQ_ENDPOINT``, ``BLOCK_SIZE``, ``PYTHONHASHSEED``,
 ``HTTP_PORT``, plus engine sizing (``TOTAL_PAGES``, ``HOST_PAGES``, ``TP``,
-``MAX_MODEL_LEN``, ``DP_RANK``) and the cross-pod KV transfer plane
+``MAX_MODEL_LEN``, ``DP_RANK``), the KV capacity tiers (``KV_QUANT``,
+``HOST_PREFETCH``, ``HOST_TIER_POLICY``) and the cross-pod KV transfer plane
 (``TRANSFER_ENDPOINT`` binds this pod's page export service — unset = off;
 ``TRANSFER_MAX_BLOCKS``, ``TRANSFER_TIMEOUT_S``).
 
@@ -259,6 +260,27 @@ class _ServingMetrics:
                 0.0,
             )
             self._steps_seen = 0
+            # Host-DRAM tier + prefetch (ISSUE 6): tier occupancy, pages
+            # served back from host DRAM (by path: ahead-of-scheduler
+            # prefetch vs blocking allocate), and prefetch-round wall time.
+            self.host_pages_g = prom.Gauge(
+                "kvcache_host_pages",
+                "KV blocks currently cached in the host-DRAM tier",
+                registry=self.registry,
+            )
+            self.host_hits = prom.Counter(
+                "kvcache_host_hits_total",
+                "KV blocks brought back from the host-DRAM tier, by path "
+                "(prefetch = ahead of the scheduler, allocate = blocking)",
+                ["path"], registry=self.registry,
+            )
+            self.host_prefetch_s = prom.Histogram(
+                "kvcache_host_prefetch_seconds",
+                "Host-tier prefetch round wall time (hash walk + restore "
+                "queueing; the DMA itself overlaps the step's dispatch)",
+                registry=self.registry, buckets=slo_buckets,
+            )
+            self._host_seen = {"restored": 0, "prefetched": 0}
 
     def observe_pull(self, seconds: float, outcome: str) -> None:
         """One ``pull_prefix`` attempt: outcome ok (imported >= 1 block),
@@ -292,6 +314,29 @@ class _ServingMetrics:
             return
         self.engine_occupancy.set(occupancy)
         self.engine_free_pages.set(free_pages)
+
+    def observe_host_prefetch(self, seconds: float) -> None:
+        if self._prom is None or not self._obs:
+            return
+        self.host_prefetch_s.observe(seconds)
+
+    def sync_host_stats(self, host_stats: dict, host_cached: int) -> None:
+        """Mirror the block manager's monotone host-tier counters (delta
+        sync, same pattern as spec/lifecycle). ``restored`` counts every
+        bring-back; the prefetch stage's share is broken out by label."""
+        if self._prom is None or not self._obs:
+            return
+        self.host_pages_g.set(host_cached)
+        d_pref = host_stats.get("prefetched", 0) - self._host_seen["prefetched"]
+        d_rest = host_stats.get("restored", 0) - self._host_seen["restored"]
+        if d_pref > 0:
+            self.host_hits.labels(path="prefetch").inc(d_pref)
+            self._host_seen["prefetched"] = host_stats["prefetched"]
+        d_alloc = d_rest - d_pref
+        if d_alloc > 0:
+            self.host_hits.labels(path="allocate").inc(d_alloc)
+        if d_rest > 0:
+            self._host_seen["restored"] = host_stats["restored"]
 
     @staticmethod
     def request_labels(seq: Sequence) -> tuple[str, str]:
@@ -580,6 +625,13 @@ class PodServerConfig:
         eng.host_tier_policy = os.environ.get(
             "HOST_TIER_POLICY", eng.host_tier_policy
         )
+        # Paged-KV quantization ("int8"): host-tier slots and transfer
+        # wire bytes halve; pages dequantize before re-entering the
+        # attention path. Unset = full-width pages, bit-identical legacy.
+        eng.kv_quant = os.environ.get("KV_QUANT") or None
+        # Host-tier prefetch: bring-back ahead of the scheduler instead of
+        # blocking inside allocate (needs HOST_PAGES > 0).
+        eng.host_prefetch = _env_bool("HOST_PREFETCH", "0")
         eng.max_model_len = int(os.environ.get("MAX_MODEL_LEN", eng.max_model_len))
         # Chunked prefill + mixed steps: per-step prefill token budget so a
         # long prompt's ingest never stalls running decode lanes (0/unset =
@@ -1059,6 +1111,24 @@ class PodServer:
                                 else 0.7 * self._loop_lag_s + 0.3 * sample
                             )
                     finished = self.engine.step()
+                    lp = self.engine.last_prefetch
+                    if lp is not None:
+                        # Host-tier bring-back ran ahead of the scheduler
+                        # this step: one span + one histogram sample per
+                        # prefetch round (noop with both OBS_* knobs off).
+                        self.engine.last_prefetch = None
+                        pages, t0, t1 = lp
+                        self.metrics.observe_host_prefetch(t1 - t0)
+                        self.tracer.record_span(
+                            "pod.host_bringback",
+                            None,
+                            t0,
+                            t1,
+                            attrs={
+                                "pages": pages,
+                                "pod": self.config.pod_identifier,
+                            },
+                        )
                     if (
                         self.transfer_cost_model is not None
                         and self.engine._prefill_rate
@@ -1084,6 +1154,11 @@ class PodServer:
                             / max(self.config.engine.decode_batch_size, 1),
                             self.engine.block_manager.num_free,
                         )
+                        if self.config.engine.block_manager.host_pages:
+                            bm = self.engine.block_manager
+                            self.metrics.sync_host_stats(
+                                bm.host_stats, bm.num_host_cached_pages
+                            )
                     for seq in finished:
                         self._resolve(seq)
         except Exception as e:  # engine wedged: fail fast and visibly
@@ -1709,6 +1784,17 @@ class PodServer:
                     "forced_requests": self.drain_forced_requests,
                 },
             }
+            if bm.config.host_pages > 0:
+                # Host tier + KV quant block only when the tier knob is on:
+                # the knobs-off /stats payload stays bit-identical.
+                payload["host"] = {
+                    "host_pages": bm.config.host_pages,
+                    "cached": bm.num_host_cached_pages,
+                    "kv_quant": self.config.engine.kv_quant,
+                    "prefetch_enabled": self.config.engine.host_prefetch,
+                    **dict(bm.host_stats),
+                    "prefetch": dict(self.engine.host_prefetch_stats),
+                }
             if self.config.obs_tracing or self.config.obs_metrics:
                 # Only with an OBS_* knob on: the knobs-off /stats payload
                 # stays bit-identical to previous rounds.

@@ -1,0 +1,573 @@
+"""KV capacity tiers suite (ISSUE 6 acceptance).
+
+int8 paged-KV quantization + the first-class host-DRAM tier with prefetch:
+
+- **Quantize→dequantize bounds**: per-element error <= scale/2, zeros
+  exact, scale geometry pinned (per-page, per-(layer, kv_head)).
+- **Spill→bring-back parity**: greedy outputs through a quantized host
+  tier match the fp, no-eviction baseline — with the ``kv_quant`` knob on
+  AND off (off = bit-identical mechanism already pinned by
+  ``test_engine``; on = the int8 round trip must not change tokens).
+- **Quantized transfer**: the wire's optional quant triple round-trips,
+  legacy response bytes are unchanged when the knob is off, quantized
+  imports reproduce cold-prefill outputs, and tampered payloads (token
+  flip, truncated scales) are rejected before anything registers.
+- **Prefetch-vs-blocking equivalence**: the ahead-of-scheduler bring-back
+  stage produces identical outputs to allocate-time restores, also when
+  the KV-event plane runs through a delaying ``ChaosLink``; after release
+  the index converges to engine ground truth — including the
+  ``medium="host_dram"`` ``BlockStored`` emitted on spill, pinned down to
+  the ``PodEntry`` tier.
+- **Observability**: ``kvcache_host_*`` metric families (OBS_METRICS
+  surface), per-path hit accounting, the ``/stats`` host block gated on
+  the tier knob, and the ``pod.host_bringback`` span.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from chaos import ChaosLink, engine_truth, index_view_of_pod
+from llm_d_kv_cache_manager_tpu.kvcache import (
+    KVCacheIndexer,
+    KVCacheIndexerConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    Key,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.keys import DeviceTier
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    KVEventsPool,
+    KVEventsPoolConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.transfer import protocol
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, quant
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    Engine,
+    EngineConfig,
+    SamplingParams,
+    SchedulerConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.serve import (
+    PodServer,
+    PodServerConfig,
+    _ServingMetrics,
+)
+
+PS = 4
+MODEL = "tiny-llama"
+
+
+def _engine_config(
+    total_pages=64,
+    host_pages=0,
+    kv_quant=None,
+    host_prefetch=False,
+    host_tier_policy="always",
+):
+    return EngineConfig(
+        model=TINY_LLAMA,
+        block_manager=BlockManagerConfig(
+            total_pages=total_pages, page_size=PS, host_pages=host_pages
+        ),
+        scheduler=SchedulerConfig(max_prefill_batch=4),
+        max_model_len=64,
+        decode_batch_size=4,
+        prefill_bucket=8,
+        interpret=True,
+        kv_quant=kv_quant,
+        host_prefetch=host_prefetch,
+        host_tier_policy=host_tier_policy,
+    )
+
+
+def _engine(**kw):
+    return Engine(_engine_config(**kw))
+
+
+def _prompt(seed, n):
+    return list(
+        map(int, np.random.default_rng(seed).integers(0, TINY_LLAMA.vocab_size, n))
+    )
+
+
+def _page(seed, shape=(3, PS, 2, 8), dtype=np.float32):
+    return (
+        np.random.default_rng(seed).standard_normal(shape).astype(dtype) * 3.7
+    )
+
+
+class TestKVPageQuantization:
+    def test_round_trip_error_bounded(self):
+        x = _page(0)
+        q, scale = quant.quantize_kv_page(x)
+        assert q.dtype == np.int8
+        d = quant.dequantize_kv_page(q, scale, np.float32)
+        # Symmetric rounding: per-element error is bounded by scale/2,
+        # broadcast over the (layer, head) the element belongs to.
+        assert (np.abs(d - x) <= scale / 2 + 1e-6).all()
+
+    def test_bf16_pages_supported(self):
+        import jax.numpy as jnp
+
+        bf16 = np.dtype(jnp.bfloat16.dtype.name)
+        x = _page(1).astype(bf16)
+        q, scale = quant.quantize_kv_page(x)
+        d = quant.dequantize_kv_page(q, scale, bf16)
+        assert d.dtype == bf16 and d.shape == x.shape
+        assert (
+            np.abs(d.astype(np.float32) - x.astype(np.float32))
+            <= scale / 2 + 0.05  # bf16 storage rounding on top of quant
+        ).all()
+
+    def test_zeros_round_trip_exactly(self):
+        q, scale = quant.quantize_kv_page(np.zeros((2, PS, 1, 4), np.float32))
+        assert (q == 0).all()
+        assert (quant.dequantize_kv_page(q, scale, np.float32) == 0).all()
+
+    def test_scale_geometry_per_layer_per_head(self):
+        shape = (3, PS, 2, 8)
+        assert quant.kv_scale_shape(shape) == (3, 1, 2, 1)
+        q, scale = quant.quantize_kv_page(_page(2, shape))
+        assert scale.shape == (3, 1, 2, 1) and scale.dtype == np.float32
+        # An outlier in one (layer, head) must not coarsen the others.
+        x = np.ones(shape, np.float32)
+        x[0, :, 0, :] = 1000.0
+        _, s2 = quant.quantize_kv_page(x)
+        assert s2[0, 0, 0, 0] > 100 * s2[0, 0, 1, 0]
+
+    def test_unknown_kv_quant_mode_rejected(self):
+        with pytest.raises(ValueError, match="kv_quant"):
+            _engine(kv_quant="fp4")
+
+
+class TestQuantizedSpillBringBack:
+    def _run(self, **kw):
+        prompts = [_prompt(70 + i, 16) for i in range(3)]
+        eng = _engine(**kw)
+        outs = []
+        for p in prompts + [prompts[0]]:
+            s = eng.add_request(p, SamplingParams(max_new_tokens=5))
+            eng.run_until_complete()
+            outs.append(s.output_tokens)
+        return eng, s, outs
+
+    def test_greedy_parity_vs_fp_baseline_knob_on_and_off(self):
+        # Baseline: pool big enough that nothing ever spills.
+        _, _, ref = self._run(total_pages=64)
+        # Tier on, full-width spills (knob off): bit-identical mechanism.
+        _, s_fp, fp = self._run(total_pages=12, host_pages=32)
+        # Tier on, int8 spills: the quantized round trip through host DRAM
+        # must still produce the same greedy tokens.
+        eng, s_q, qt = self._run(total_pages=12, host_pages=32, kv_quant="int8")
+        assert fp == ref and qt == ref
+        assert s_fp.num_cached_prompt > 0 and s_q.num_cached_prompt > 0
+        assert eng.block_manager.host_stats["spilled"] > 0
+        assert eng.block_manager.host_stats["restored"] > 0
+
+    def test_quantized_host_pool_halves_slot_bytes(self):
+        fp = _engine(total_pages=12, host_pages=8)
+        q8 = _engine(total_pages=12, host_pages=8, kv_quant="int8")
+        assert q8._host_k.dtype == np.int8
+        # int8 payload + f32 per-(layer, head) scales is well under half
+        # the bf16/fp32 slot bytes for any realistic head_dim.
+        fp_bytes = fp._host_k.nbytes
+        q_bytes = q8._host_k.nbytes + q8._host_k_scale.nbytes
+        assert q_bytes <= fp_bytes // 2 + q8._host_k_scale.nbytes
+
+
+class TestQuantizedTransferWire:
+    def _warm_engine(self, prompt, **kw):
+        eng = _engine(**kw)
+        eng.add_request(prompt, SamplingParams(max_new_tokens=4))
+        eng.run_until_complete()
+        return eng
+
+    def test_legacy_response_bytes_unchanged_when_off(self):
+        import msgpack
+
+        prompt = _prompt(80, 24)
+        eng = self._warm_engine(prompt)
+        hashes = eng.block_manager.token_db.prefix_hashes(prompt)
+        blocks = eng.export_kv_blocks(hashes)
+        assert blocks and all(b.quant is None for b in blocks)
+        legacy = msgpack.packb(
+            [
+                "Blocks",
+                True,
+                [
+                    [
+                        b.block_hash,
+                        b.parent_block_hash,
+                        list(b.token_ids),
+                        b.block_size,
+                        b.dtype,
+                        list(b.shape),
+                        b.k_data,
+                        b.v_data,
+                    ]
+                    for b in blocks
+                ],
+            ],
+            use_bin_type=True,
+        )
+        assert protocol.encode_response(blocks, True) == legacy
+
+    def test_quant_triple_rides_the_wire(self):
+        prompt = _prompt(81, 24)
+        eng = self._warm_engine(prompt, kv_quant="int8")
+        hashes = eng.block_manager.token_db.prefix_hashes(prompt)
+        blocks = eng.export_kv_blocks(hashes)
+        assert blocks and all(b.quant == "int8" for b in blocks)
+        # int8 payload: one byte per element of the logical page shape.
+        assert len(blocks[0].k_data) == int(np.prod(blocks[0].shape))
+        assert len(blocks[0].k_scale) == (
+            int(np.prod(quant.kv_scale_shape(tuple(blocks[0].shape)))) * 4
+        )
+        dec, complete, err = protocol.decode_response(
+            protocol.encode_response(blocks, True)
+        )
+        assert err is None and complete
+        assert [(b.block_hash, b.quant, b.k_scale) for b in dec] == [
+            (b.block_hash, b.quant, b.k_scale) for b in blocks
+        ]
+
+    def test_quantized_import_matches_cold_prefill(self):
+        prompt = _prompt(82, 24)
+        src = self._warm_engine(prompt, kv_quant="int8")
+        hashes = src.block_manager.token_db.prefix_hashes(prompt)
+        wire = protocol.decode_response(
+            protocol.encode_response(src.export_kv_blocks(hashes), True)
+        )[0]
+        # Import into an UNQUANTIZED engine: dequantized before the pool.
+        tgt = _engine()
+        assert tgt.import_kv_blocks(wire) == len(wire)
+        s_warm = tgt.add_request(prompt, SamplingParams(max_new_tokens=4))
+        tgt.run_until_complete()
+        cold = _engine()
+        s_cold = cold.add_request(prompt, SamplingParams(max_new_tokens=4))
+        cold.run_until_complete()
+        assert s_warm.output_tokens == s_cold.output_tokens
+        assert s_warm.num_cached_prompt > 0
+
+    def test_tampered_tokens_rejected(self):
+        prompt = _prompt(83, 24)
+        src = self._warm_engine(prompt, kv_quant="int8")
+        hashes = src.block_manager.token_db.prefix_hashes(prompt)
+        blocks = src.export_kv_blocks(hashes)
+        blocks[0].token_ids = list(blocks[0].token_ids)
+        blocks[0].token_ids[0] ^= 1
+        tgt = _engine()
+        assert tgt.import_kv_blocks(blocks) == 0
+        assert tgt.transfer_stats["import_rejected"] == 1
+
+    def test_truncated_scale_rejected_as_geometry(self):
+        prompt = _prompt(84, 24)
+        src = self._warm_engine(prompt, kv_quant="int8")
+        hashes = src.block_manager.token_db.prefix_hashes(prompt)
+        blocks = src.export_kv_blocks(hashes)
+        blocks[0].k_scale = blocks[0].k_scale[:-4]
+        tgt = _engine()
+        assert tgt.import_kv_blocks(blocks) == 0
+        assert tgt.transfer_stats["import_rejected"] == 1
+
+    def test_host_tier_sourced_export_is_importable(self):
+        # Spill the first prompt's pages to the (int8) host tier, then
+        # export its chain: blocks served FROM host DRAM must import and
+        # reproduce the cold output like HBM-sourced ones.
+        prompts = [_prompt(85 + i, 16) for i in range(3)]
+        src = _engine(total_pages=12, host_pages=32, kv_quant="int8")
+        for p in prompts:
+            src.add_request(p, SamplingParams(max_new_tokens=4))
+            src.run_until_complete()
+        hashes = src.block_manager.token_db.prefix_hashes(prompts[0])
+        chain = src.block_manager.lookup_chain(hashes)
+        assert any(tier == "host_dram" for _, _, tier, _ in chain)
+        blocks = src.export_kv_blocks(hashes)
+        assert blocks
+        tgt = _engine()
+        assert tgt.import_kv_blocks(blocks) == len(blocks)
+        s_warm = tgt.add_request(prompts[0], SamplingParams(max_new_tokens=4))
+        tgt.run_until_complete()
+        cold = _engine()
+        s_cold = cold.add_request(prompts[0], SamplingParams(max_new_tokens=4))
+        cold.run_until_complete()
+        assert s_warm.output_tokens == s_cold.output_tokens
+
+
+class TestHostPrefetch:
+    def _workload(self, eng):
+        """Thrash-then-repeat: fill past the HBM pool so early prompts
+        spill, then repeat them — the repeats are host-tier hits."""
+        prompts = [_prompt(90 + i, 16) for i in range(4)]
+        outs = []
+        for p in prompts + prompts[:2]:
+            s = eng.add_request(p, SamplingParams(max_new_tokens=5))
+            eng.run_until_complete()
+            outs.append(s.output_tokens)
+        return outs
+
+    def test_prefetch_equivalent_to_blocking_allocate(self):
+        ref = self._workload(_engine(total_pages=64))
+        blocking = self._workload(
+            _engine(total_pages=12, host_pages=32, kv_quant="int8")
+        )
+        eng = _engine(
+            total_pages=12, host_pages=32, kv_quant="int8", host_prefetch=True
+        )
+        prefetched = self._workload(eng)
+        assert blocking == ref and prefetched == ref
+        assert eng.host_prefetch_stats["pages"] > 0
+        assert eng.block_manager.host_stats["prefetched"] > 0
+        # Every prefetched page is also counted as restored (same mover).
+        hs = eng.block_manager.host_stats
+        assert hs["restored"] >= hs["prefetched"]
+
+    def test_prefetch_respects_cost_model_decline(self):
+        eng = _engine(
+            total_pages=12,
+            host_pages=32,
+            host_prefetch=True,
+            host_tier_policy="auto",
+        )
+        prompts = [_prompt(95 + i, 16) for i in range(3)]
+        for p in prompts:
+            # Pin the EMAs so restoring always loses to recompute: the
+            # prefetch stage must decline exactly like blocking allocate.
+            eng._prefill_rate = 1e9
+            eng._restore_rate = 1e-3
+            eng.add_request(p, SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        eng._prefill_rate = 1e9
+        eng._restore_rate = 1e-3
+        s = eng.add_request(prompts[0], SamplingParams(max_new_tokens=4))
+        eng.run_until_complete()
+        assert eng.host_prefetch_stats["pages"] == 0
+        assert s.num_cached_prompt == 0  # declined: honest recompute
+
+    def test_prefetch_hash_memo_survives_waiting(self):
+        eng = _engine(total_pages=32, host_pages=8, host_prefetch=True)
+        seq = eng.add_request(_prompt(99, 16), SamplingParams(max_new_tokens=2))
+        eng.step()
+        # Memo either unset (no host pages yet: stage short-circuits) or
+        # the exact chain allocate computes.
+        if seq.prefetch_hashes is not None:
+            assert seq.prefetch_hashes == (
+                eng.block_manager.token_db.prefix_hashes(seq.prompt_tokens)
+            )
+
+
+class TestHostTierIndexConvergence:
+    """The scorer's tier-aware view must match engine ground truth across
+    spills — pinned through the real event wire, with delayed delivery."""
+
+    def _plane(self):
+        indexer = KVCacheIndexer(
+            KVCacheIndexerConfig(
+                token_processor=TokenProcessorConfig(block_size=PS)
+            )
+        )
+        pool = KVEventsPool(
+            indexer.kv_block_index, KVEventsPoolConfig(concurrency=2)
+        )
+        pool.start()
+        return indexer, pool
+
+    def _pod(self, pool, pod_id, **engine_kw):
+        link = ChaosLink(pool, pod_id, MODEL)
+        server = PodServer(
+            PodServerConfig(
+                model_name=MODEL,
+                pod_identifier=pod_id,
+                publish_events=False,
+                engine=_engine_config(**engine_kw),
+            ),
+            publisher=link,
+        )
+        server.start()
+        return server, link
+
+    def test_spill_stored_host_dram_and_index_converges(self):
+        indexer, pool = self._plane()
+        server, link = self._pod(
+            pool, "tier-pod-0", total_pages=12, host_pages=32, kv_quant="int8"
+        )
+        try:
+            for i in range(3):
+                server.generate(
+                    _prompt(100 + i, 16),
+                    SamplingParams(max_new_tokens=3),
+                    timeout=120,
+                )
+            assert pool.drain(timeout=10)
+            digest = server.engine.block_manager.block_digest()
+            assert digest["host_dram"]  # spills actually happened
+            # Index view == engine truth over every hash the link carried:
+            # without the BlockStored(host_dram) on spill, spilled blocks
+            # would vanish from the index while the engine still holds
+            # them — exactly the divergence this pins.
+            truth = engine_truth(server)
+            view = index_view_of_pod(
+                indexer.kv_block_index, MODEL, link.seen_hashes, "tier-pod-0"
+            )
+            assert view == truth
+            # And the tier is recorded, not just membership.
+            h = int(digest["host_dram"][0])
+            entries = indexer.kv_block_index._data.get(Key(MODEL, h)).cache.keys()
+            tiers = {e.device_tier for e in entries}
+            assert tiers == {DeviceTier.HOST_DRAM}
+        finally:
+            server.shutdown()
+            pool.shutdown()
+
+    def test_prefetch_equivalence_under_delayed_events(self):
+        # The chaos delay link holds the event stream while requests flow:
+        # prefetch-on and prefetch-off pods must produce identical outputs
+        # regardless, and after release both converge to ground truth.
+        outs = {}
+        for flag in (False, True):
+            indexer, pool = self._plane()
+            server, link = self._pod(
+                pool,
+                f"tier-pod-{int(flag)}",
+                total_pages=12,
+                host_pages=32,
+                kv_quant="int8",
+                host_prefetch=flag,
+            )
+            try:
+                link.delay_next(1000)  # hold everything
+                prompts = [_prompt(110 + i, 16) for i in range(3)]
+                res = []
+                for p in prompts + [prompts[0]]:
+                    s = server.generate(
+                        p, SamplingParams(max_new_tokens=3), timeout=120
+                    )
+                    res.append(s.output_tokens)
+                outs[flag] = res
+                link.release_held()
+                assert pool.drain(timeout=10)
+                truth = engine_truth(server)
+                view = index_view_of_pod(
+                    indexer.kv_block_index,
+                    MODEL,
+                    link.seen_hashes,
+                    server.config.pod_identifier,
+                )
+                assert view == truth
+            finally:
+                server.shutdown()
+                pool.shutdown()
+        assert outs[False] == outs[True]
+
+
+class TestHostObservability:
+    def test_host_metric_names_and_types(self):
+        pytest.importorskip("prometheus_client")
+        m = _ServingMetrics(obs=True)
+        text = m.exposition().decode()
+        types = {}
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                _, _, name, typ = line.split(" ")
+                types[name] = typ
+        assert types.get("kvcache_host_pages") == "gauge"
+        assert types.get("kvcache_host_hits_total") == "counter"
+        assert types.get("kvcache_host_prefetch_seconds") == "histogram"
+        # And the families stay off the default exposition surface.
+        off = _ServingMetrics(obs=False).exposition().decode()
+        assert "kvcache_host_" not in off
+
+    def test_sync_host_stats_splits_paths(self):
+        pytest.importorskip("prometheus_client")
+        m = _ServingMetrics(obs=True)
+        m.sync_host_stats({"restored": 5, "prefetched": 3}, host_cached=7)
+        m.sync_host_stats({"restored": 5, "prefetched": 3}, host_cached=7)
+        text = m.exposition().decode()
+        assert 'kvcache_host_hits_total{path="prefetch"} 3.0' in text
+        assert 'kvcache_host_hits_total{path="allocate"} 2.0' in text
+        assert "kvcache_host_pages 7.0" in text
+
+    def _run_app(self, server, scenario):
+        server.start()
+
+        async def runner():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                await scenario(client)
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            server.shutdown()
+
+    def test_stats_host_block_gated_on_tier_knob(self):
+        async def with_tier(c):
+            resp = await c.get("/stats")
+            stats = await resp.json()
+            assert stats["host"]["host_pages"] == 8
+            assert stats["host"]["kv_quant"] == "int8"
+            assert "prefetch" in stats["host"]
+
+        async def without_tier(c):
+            resp = await c.get("/stats")
+            assert "host" not in await resp.json()
+
+        self._run_app(
+            PodServer(
+                PodServerConfig(
+                    model_name=MODEL,
+                    pod_identifier="host-stats-pod",
+                    publish_events=False,
+                    engine=_engine_config(host_pages=8, kv_quant="int8"),
+                )
+            ),
+            with_tier,
+        )
+        self._run_app(
+            PodServer(
+                PodServerConfig(
+                    model_name=MODEL,
+                    pod_identifier="host-stats-pod-2",
+                    publish_events=False,
+                    engine=_engine_config(),
+                )
+            ),
+            without_tier,
+        )
+
+    def test_bringback_span_recorded(self):
+        server = PodServer(
+            PodServerConfig(
+                model_name=MODEL,
+                pod_identifier="span-pod",
+                publish_events=False,
+                obs_tracing=True,
+                engine=_engine_config(
+                    total_pages=12, host_pages=32, host_prefetch=True
+                ),
+            )
+        )
+        server.start()
+        try:
+            prompts = [_prompt(120 + i, 16) for i in range(3)]
+            for p in prompts + [prompts[0]]:
+                server.generate(p, SamplingParams(max_new_tokens=3), timeout=120)
+            spans = [
+                s
+                for trace in server.tracer.traces(limit=1000)
+                for s in trace["spans"]
+                if s["name"] == "pod.host_bringback"
+            ]
+            assert spans, "prefetch ran but no bringback span recorded"
+            assert spans[0]["attrs"]["pages"] > 0
+        finally:
+            server.shutdown()

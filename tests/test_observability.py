@@ -222,6 +222,10 @@ _POD_OBS_METRICS = {
     "kvcache_engine_batch_occupancy": "gauge",
     "kvcache_engine_free_pages": "gauge",
     "kvcache_engine_loop_lag_seconds": "gauge",
+    # Host-DRAM tier + prefetch (ISSUE 6)
+    "kvcache_host_pages": "gauge",
+    "kvcache_host_hits_total": "counter",
+    "kvcache_host_prefetch_seconds": "histogram",
 }
 
 #: Scorer-side collector metrics added by PR 5 (global registry).
