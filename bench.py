@@ -87,6 +87,16 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
                        time; pull counts land in the detail JSON
   BENCH_TRANSFER_GBPS=N  modeled DCN link rate for the pull charge and the
                        cost model's seed transfer rate (default 10)
+  BENCH_DECODE_FASTPATH=1  decode fast path on every arm's engines
+                       (DECODE_FUSED_SAMPLING + DECODE_PIPELINE: device-
+                       resident last tokens across steps, async D2H of
+                       sampled ids) — the ISSUE 7 throughput knob
+  BENCH_SPEC_DECODE=prompt_lookup  adds a `precise_spec` arm (precise
+                       routing with speculative decoding) reporting an
+                       acceptance-rate column
+  BENCH_STEP_PHASES=1  per-arm engine step-phase decomposition
+                       (schedule/prefill/decode/sample/gather/publish
+                       seconds) in the detail JSON
 """
 
 from __future__ import annotations
@@ -181,6 +191,14 @@ class LaggedEventBus:
 #: cleaned.
 STALL_CAP_X = float(os.environ.get("BENCH_STALL_CAP_X", "20"))
 
+#: Per-arm engine step-phase decomposition (BENCH_STEP_PHASES=1): every
+#: pod engine records schedule/prefill/decode/sample/gather/publish wall
+#: seconds (the PR 5 telemetry), aggregated into the detail JSON — the
+#: "where did the step time go" columns of the decode-fast-path record.
+#: Off by default: the extra clock reads, though small, perturb measured
+#: step times.
+STEP_PHASES = os.environ.get("BENCH_STEP_PHASES", "0") == "1"
+
 
 class Pod:
     """One simulated serving replica: a real engine + a virtual clock."""
@@ -201,6 +219,7 @@ class Pod:
             params=params,
             on_events=lambda events: self._unstamped.append(make_msg(events)),
         )
+        self.engine.obs_step_timing = STEP_PHASES
         self.clock = 0.0
         self.seqs = []  # every sequence routed here
         self.hit_stats: dict[int, tuple[int, int]] = {}  # first-prefill hits
@@ -502,6 +521,28 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
             for key, val in p.engine.host_prefetch_stats.items():
                 key = f"prefetch_{key}"
                 host_detail[key] = host_detail.get(key, 0) + val
+    # Speculative-decode evidence (spec arms): fleet-aggregated proposal/
+    # acceptance counters — the acceptance-rate column of the record.
+    spec_detail = None
+    if engine_cfg.spec_decode != "off":
+        spec_detail = {"proposed": 0, "accepted": 0, "verify_steps": 0, "bursts": 0}
+        for p in pods:
+            for key in spec_detail:
+                spec_detail[key] += p.engine.spec_stats[key]
+        spec_detail["acceptance_rate"] = (
+            round(spec_detail["accepted"] / spec_detail["proposed"], 4)
+            if spec_detail["proposed"]
+            else None
+        )
+    # Step-phase decomposition (BENCH_STEP_PHASES=1): fleet-summed engine
+    # phase seconds, so each arm's record shows where step time went
+    # (sample ~ 0 when the fused fast path overlaps the device_get).
+    phase_detail = None
+    if STEP_PHASES:
+        phase_detail = {}
+        for p in pods:
+            for key, val in p.engine.step_stats.items():
+                phase_detail[key] = round(phase_detail.get(key, 0) + val, 4)
     # The Pod.on_events closure references the Pod (staging buffer), so
     # Pod <-> Engine is now a reference CYCLE: without an explicit collect,
     # each policy's engines (~GBs of donated KV pools on the chip) survive
@@ -538,6 +579,8 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
             else {}
         ),
         **({"host": host_detail} if host_detail is not None else {}),
+        **({"spec": spec_detail} if spec_detail is not None else {}),
+        **({"phases": phase_detail} if phase_detail is not None else {}),
     }
 
 
@@ -653,6 +696,11 @@ def main() -> int:
     kv_quant = os.environ.get("BENCH_KV_QUANT", "int8") or None
     host_prefetch = os.environ.get("BENCH_HOST_PREFETCH", "1") == "1"
     host_tier_policy = os.environ.get("BENCH_HOST_TIER_POLICY", "always")
+    # Decode fast path (ISSUE 7): device-resident last tokens across steps
+    # + async D2H of sampled ids, on EVERY arm's engines so the policy
+    # comparison stays apples-to-apples.
+    decode_fastpath = os.environ.get("BENCH_DECODE_FASTPATH", "0") == "1"
+    spec_mode = os.environ.get("BENCH_SPEC_DECODE", "") or None
     engine_cfg = EngineConfig(
         model=model_cfg,
         block_manager=BlockManagerConfig(
@@ -669,6 +717,8 @@ def main() -> int:
         max_model_len=max_len,
         decode_batch_size=8,
         decode_steps_per_iter=decode_burst,
+        decode_pipeline=decode_fastpath,
+        decode_fused_sampling=decode_fastpath,
         prefill_bucket=64,
         # Pin warm prefills AND decode tables to a single width → one
         # compiled shape each. Mid-run XLA compiles (~30-60s on this model)
@@ -722,6 +772,18 @@ def main() -> int:
     for policy in policies:
         results[policy] = run_policy(
             policy, workload, params, engine_cfg, n_pods, max_new
+        )
+
+    # Speculative-decode arm (BENCH_SPEC_DECODE=prompt_lookup): precise
+    # routing with the prompt-lookup speculative path live in every pod
+    # engine — graduated from dryrun-only to a measured arm with an
+    # acceptance-rate column.
+    if spec_mode and "precise" in policies:
+        import dataclasses as _dc
+
+        spec_cfg = _dc.replace(engine_cfg, spec_decode=spec_mode)
+        results["precise_spec"] = run_policy(
+            "precise", workload, params, spec_cfg, n_pods, max_new
         )
 
     # -- Pressure regime (the product's differentiator) -------------------
@@ -808,6 +870,9 @@ def main() -> int:
         "host_pages": host_pages,
         "total_pages": total_pages,
         "chunked_prefill_tokens": chunked if chunked > 0 else None,
+        "decode_fastpath": decode_fastpath,
+        "spec_decode": spec_mode,
+        "step_phases": STEP_PHASES,
         "transfer": os.environ.get("BENCH_TRANSFER", "0") == "1",
         "event_lag_ms": float(os.environ.get("BENCH_EVENT_LAG_MS", "2")),
         "qps_ramp": [round(q, 2) for q in qps_ramp],
@@ -873,6 +938,22 @@ def main() -> int:
                 ),
                 "output_tok_s_per_chip": (
                     round(precise["output_tok_s_per_chip"], 1) if precise else None
+                ),
+                "decode_fastpath": decode_fastpath,
+                # Spec-decode arm headline: acceptance rate + throughput
+                # (null unless BENCH_SPEC_DECODE ran the arm).
+                "spec": (
+                    {
+                        "mode": spec_mode,
+                        "acceptance_rate": results["precise_spec"]["spec"][
+                            "acceptance_rate"
+                        ],
+                        "output_tok_s_per_chip": round(
+                            results["precise_spec"]["output_tok_s_per_chip"], 1
+                        ),
+                    }
+                    if "precise_spec" in results
+                    else None
                 ),
                 # Serving-SLO latency columns (precise policy): the perf
                 # trajectory tracks tails, not just medians/throughput.

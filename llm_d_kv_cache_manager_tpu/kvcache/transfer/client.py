@@ -16,6 +16,13 @@ prefix cold", never wedge or crash the puller. So:
   cold-prefill fallback runs with zero added latency) until an exponential
   backoff expires, then exactly one HALF_OPEN probe decides between CLOSED
   (recovered) and OPEN with doubled backoff.
+
+Thread model: ``fetch`` is thread-safe but serializes per client — the
+DEALER socket is single-request-in-flight by construction (a lock held
+across send→recv is what makes the timeout/teardown story airtight).
+``ASYNC_PULL`` workers therefore contend only when pulling from the SAME
+peer (one client per endpoint in ``PodServer``); distinct peers fetch
+fully in parallel. Sizing guidance in docs/operations.md.
 """
 
 from __future__ import annotations

@@ -1123,6 +1123,15 @@ def decode_steps(
         return (nxt, positions + 1, seq_lens + 1, k_pages, v_pages), nxt
 
     keys = jax.random.split(rng_key, num_steps)
+    if num_steps == 1:
+        # The device-resident step-per-token loop (decode_fused_sampling
+        # at k=1) lands here every iteration: skip the scan machinery for
+        # a plain body call. Consumes keys[0] exactly like the scan's
+        # first slice, so sampled streams are bit-identical across paths.
+        (_, _, _, k_pages, v_pages), nxt = body(
+            (tokens, positions, seq_lens, k_pages, v_pages), keys[0]
+        )
+        return nxt[:, None], k_pages, v_pages
     (_, _, _, k_pages, v_pages), toks = jax.lax.scan(
         body, (tokens, positions, seq_lens, k_pages, v_pages), keys
     )

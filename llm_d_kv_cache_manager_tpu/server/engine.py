@@ -109,6 +109,18 @@ class EngineConfig:
     #: bit-identical across the two modes: discarded surplus bursts
     #: consume extra splits of the engine rng.)
     decode_pipeline: bool = False
+    #: device-resident decode fast path (``DECODE_FUSED_SAMPLING``): keep
+    #: per-sequence last-token ids and positions/lengths ON DEVICE across
+    #: engine iterations at ANY ``decode_steps_per_iter`` (the pipelined
+    #: double-buffering above, extended down to k=1 — every steady-state
+    #: decode step chains from the previous dispatch's on-device sample
+    #: instead of a host round-trip), and start the batched D2H copy of
+    #: each burst's sampled tokens ASYNC right after the dispatch, so the
+    #: bytes land while the next step executes instead of blocking the
+    #: commit. Greedy outputs are bit-identical to the unfused engine
+    #: (same drain rules as decode_pipeline; the same temperature>0
+    #: rng-split caveat applies). Off by default = legacy behavior.
+    decode_fused_sampling: bool = False
     #: prefill attention implementation: "auto" (Pallas flash kernel on
     #: TPU, XLA scan elsewhere), "pallas", or "xla".
     prefill_attn: str = "auto"
@@ -202,7 +214,12 @@ class Engine:
         self.model_cfg = cfg
         ps = config.block_manager.page_size
         self.page_size = ps
-        self._pipeline = config.decode_pipeline and config.decode_steps_per_iter > 1
+        # decode_fused_sampling keeps the burst machinery live at any k
+        # (k=1 pipelining is exactly the device-resident step-per-token
+        # loop); decode_pipeline alone still needs k > 1 to pay off.
+        self._pipeline = (
+            config.decode_pipeline and config.decode_steps_per_iter > 1
+        ) or config.decode_fused_sampling
         # Width includes fused-burst headroom: a sequence finishing at
         # max_model_len mid-burst keeps writing its surplus KV into reserved
         # pages of its own row, never into another sequence's pages.
@@ -423,6 +440,9 @@ class Engine:
         #: engine-step telemetry (PR 5, ``OBS_METRICS``): cumulative wall
         #: seconds per step phase — schedule (deadline shed + scheduler),
         #: prefill (dispatch + sampling), decode (dispatch + commit),
+        #: sample (host-side blocking fetch of sampled tokens — the
+        #: device_get the fused fast path overlaps; a slice of the
+        #: prefill/decode phases, broken out so fusion is visible),
         #: gather (host<->device page moves, overlaps prefill/decode),
         #: publish (finish detection + KV-event flush). Off by default:
         #: ``obs_step_timing=False`` skips every clock read, so the legacy
@@ -433,6 +453,7 @@ class Engine:
             "schedule_s": 0.0,
             "prefill_s": 0.0,
             "decode_s": 0.0,
+            "sample_s": 0.0,
             "gather_s": 0.0,
             "publish_s": 0.0,
         }
@@ -956,6 +977,13 @@ class Engine:
     def has_work(self) -> bool:
         return self.scheduler.has_work
 
+    @property
+    def has_ready_work(self) -> bool:
+        """``has_work`` minus waiting sequences still importing their
+        async-pulled prefix — the serving loop's step gate, so a stalled
+        wire parks the loop on its condition instead of busy-spinning."""
+        return self.scheduler.has_ready_work
+
     def step(self) -> list[Sequence]:
         """One engine iteration. Returns sequences finished this step.
 
@@ -1333,6 +1361,16 @@ class Engine:
             interpret=self.config.interpret,
             mesh=self.mesh,
         )
+        if self.config.decode_fused_sampling:
+            # Start the batched D2H copy of this burst's sampled ids NOW,
+            # overlapped with whatever dispatches next — by the time the
+            # lagged commit calls np.asarray the bytes are already on the
+            # host, collapsing the per-step device_get to ~zero exposed
+            # time. Purely a transfer hint: results are unchanged.
+            try:
+                toks.copy_to_host_async()
+            except AttributeError:  # backend without async host copies
+                pass
         burst = {
             "toks": toks,
             "active": active,
@@ -1562,7 +1600,10 @@ class Engine:
         # The one host sync of the burst: ONE packed fetch (emit tokens +
         # per-round counters in a single array — separate fetches would
         # serialize several blocking round-trips on high-latency links).
+        t_fetch = time.perf_counter() if self.obs_step_timing else 0.0
         packed = np.asarray(packed)  # [rounds, b, k+4]
+        if self.obs_step_timing:
+            self.step_stats["sample_s"] += time.perf_counter() - t_fetch
         emit = packed[..., : k + 1]
         emit_len = packed[..., k + 1]
         prop_len = packed[..., k + 2]
@@ -1610,7 +1651,13 @@ class Engine:
         self._commit_burst(burst)
 
     def _commit_burst(self, burst: dict) -> None:
+        timed = self.obs_step_timing
+        t0 = time.perf_counter() if timed else 0.0
         toks = np.asarray(burst["toks"])  # [lanes, k] — the one host sync
+        if timed:
+            # The blocking share of the sampled-token fetch: near-zero when
+            # the fused fast path's async copy already landed the bytes.
+            self.step_stats["sample_s"] += time.perf_counter() - t0
         for i, seq in enumerate(burst["active"]):
             if not seq.block_table:
                 continue  # preempted after this burst was dispatched
@@ -1724,6 +1771,8 @@ class Engine:
             top_k[i] = seq.sampling.top_k
             top_p[i] = seq.sampling.top_p
         self._rng, key = jax.random.split(self._rng)
+        timed = self.obs_step_timing
+        t0 = time.perf_counter() if timed else 0.0
         out = sample_tokens(
             logits.astype(jnp.float32),
             jnp.asarray(temperature),
@@ -1731,4 +1780,7 @@ class Engine:
             jnp.asarray(top_p),
             key,
         )
-        return np.asarray(out)
+        out = np.asarray(out)
+        if timed:
+            self.step_stats["sample_s"] += time.perf_counter() - t0
+        return out

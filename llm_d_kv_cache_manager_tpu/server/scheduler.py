@@ -28,6 +28,7 @@ page-budget admission prevents over-commit.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional
@@ -84,6 +85,33 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.prefilling or self.running)
 
+    @property
+    def has_ready_work(self) -> bool:
+        """Work the engine could make progress on THIS step — ``has_work``
+        minus waiting sequences whose async KV-pull is still importing
+        (stepping for those alone would busy-spin until the wire
+        delivers). The head-of-deque check keeps the common no-import
+        case O(1)."""
+        if self.prefilling or self.running:
+            return True
+        w = self.waiting
+        if not w:
+            return False
+        if not w[0].importing:
+            return True
+        return any(not s.importing for s in w)
+
+    def _skip_importing(self, idx: int) -> int:
+        """Advance ``idx`` past waiting sequences mid-import, stamping the
+        first time each would otherwise have been an admission candidate
+        (the hidden/exposed boundary of the pull-overlap decomposition)."""
+        while idx < len(self.waiting) and self.waiting[idx].importing:
+            seq = self.waiting[idx]
+            if seq.import_wanted_time is None:
+                seq.import_wanted_time = time.monotonic()
+            idx += 1
+        return idx
+
     def shed_expired(self, now: float) -> list[Sequence]:
         """Deadline shedding for requests that have not produced a token
         yet: expired WAITING sequences are dropped before any prefill
@@ -127,15 +155,22 @@ class Scheduler:
         """Pick the work for one engine step."""
         if self.config.chunked_prefill_tokens is not None:
             return self._schedule_chunked()
-        # Admit waiting sequences first (prefill priority).
+        # Admit waiting sequences first (prefill priority). Sequences
+        # whose async KV-pull is still importing are skipped in place
+        # (admission continues past them — the wire must never stall
+        # later arrivals); with no imports in flight the walk is the
+        # legacy head-of-deque FCFS loop exactly.
         prefill: list[Sequence] = []
         budget = self.config.max_prefill_tokens
+        idx = 0
         while (
-            self.waiting
-            and len(prefill) < self.config.max_prefill_batch
+            len(prefill) < self.config.max_prefill_batch
             and len(self.running) + len(prefill) < self.config.max_running
         ):
-            seq = self.waiting[0]
+            idx = self._skip_importing(idx)
+            if idx >= len(self.waiting):
+                break
+            seq = self.waiting[idx]
             if not self.block_manager.can_allocate(seq):
                 break  # FCFS: wait for pages rather than starving this seq
             try:
@@ -150,7 +185,7 @@ class Scheduler:
                 self.block_manager.free_sequence(seq)
                 seq.reset_allocation()
                 break
-            self.waiting.popleft()
+            del self.waiting[idx]
             budget -= suffix
             prefill.append(seq)
 
@@ -195,14 +230,18 @@ class Scheduler:
             chunks.append(take)
             budget -= take
 
-        # Then admit new sequences under the page-budget/FCFS rules.
+        # Then admit new sequences under the page-budget/FCFS rules
+        # (mid-import sequences skipped in place, as in the legacy loop).
+        idx = 0
         while (
-            self.waiting
-            and budget > 0
+            budget > 0
             and len(prefill) < self.config.max_prefill_batch
             and len(self.running) + len(self.prefilling) < self.config.max_running
         ):
-            seq = self.waiting[0]
+            idx = self._skip_importing(idx)
+            if idx >= len(self.waiting):
+                break
+            seq = self.waiting[idx]
             if not self.block_manager.can_allocate(seq):
                 break  # FCFS: wait for pages rather than starving this seq
             try:
@@ -217,7 +256,7 @@ class Scheduler:
                 self.block_manager.free_sequence(seq)
                 seq.reset_allocation()
                 break
-            self.waiting.popleft()
+            del self.waiting[idx]
             self.prefilling.append(seq)
             prefill.append(seq)
             chunks.append(take)

@@ -89,6 +89,17 @@ class Sequence:
     #: stage (hashing is O(prompt) sha256 work; a sequence may wait many
     #: steps). Invalidated when preemption folds output into the prompt.
     prefetch_hashes: Optional[list[int]] = None
+    #: async KV-pull (``ASYNC_PULL``): True while a background transfer
+    #: fetch is importing this sequence's warm prefix — the scheduler
+    #: skips it (admitting later waiting sequences past it) until the
+    #: import lands or fails, so a slow wire never stalls admission.
+    #: False (default) = legacy behavior, the scheduler never checks it.
+    importing: bool = False
+    #: when the scheduler FIRST skipped this sequence because its import
+    #: was still in flight — the hidden/exposed boundary of the pull
+    #: overlap decomposition (pull time before this instant was hidden
+    #: behind other work; time after it delayed this sequence's prefill).
+    import_wanted_time: Optional[float] = None
 
     def __post_init__(self):
         if self.user_prompt_len < 0:

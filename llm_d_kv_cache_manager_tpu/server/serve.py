@@ -21,7 +21,9 @@ Config comes from env vars mirroring the reference's online service
 ``MAX_MODEL_LEN``, ``DP_RANK``), the KV capacity tiers (``KV_QUANT``,
 ``HOST_PREFETCH``, ``HOST_TIER_POLICY``) and the cross-pod KV transfer plane
 (``TRANSFER_ENDPOINT`` binds this pod's page export service — unset = off;
-``TRANSFER_MAX_BLOCKS``, ``TRANSFER_TIMEOUT_S``).
+``TRANSFER_MAX_BLOCKS``, ``TRANSFER_TIMEOUT_S``; ``ASYNC_PULL`` +
+``PULL_WORKERS`` import pulled prefixes in the background instead of
+blocking submission) and the decode fast path (``DECODE_FUSED_SAMPLING``).
 
 Run: ``python -m llm_d_kv_cache_manager_tpu.server.serve``
 """
@@ -34,7 +36,7 @@ import threading
 import time
 import uuid
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
 from typing import Optional
@@ -227,6 +229,14 @@ class _ServingMetrics:
                 "(ok/empty/failed)",
                 ["outcome"], registry=self.registry, buckets=slo_buckets,
             )
+            self.pull_overlap = prom.Histogram(
+                "kvcache_transfer_pull_overlap_seconds",
+                "Async KV-pull (ASYNC_PULL) wall time split by exposure: "
+                "hidden = spent before the scheduler first wanted the "
+                "sequence (overlapped with other work), exposed = the "
+                "remainder (it delayed this sequence's prefill)",
+                ["kind"], registry=self.registry, buckets=slo_buckets,
+            )
             self.engine_steps = prom.Counter(
                 "kvcache_engine_steps_total",
                 "Engine iterations",
@@ -235,8 +245,8 @@ class _ServingMetrics:
             self.engine_phase_s = prom.Counter(
                 "kvcache_engine_step_phase_seconds_total",
                 "Cumulative engine-step wall seconds by phase (schedule/"
-                "prefill/decode/gather/publish; gather overlaps the "
-                "dispatch phases)",
+                "prefill/decode/sample/gather/publish; gather and sample "
+                "overlap the dispatch phases)",
                 ["phase"], registry=self.registry,
             )
             self.engine_occupancy = prom.Gauge(
@@ -256,7 +266,10 @@ class _ServingMetrics:
                 registry=self.registry,
             )
             self._step_seen = dict.fromkeys(
-                ("schedule_s", "prefill_s", "decode_s", "gather_s", "publish_s"),
+                (
+                    "schedule_s", "prefill_s", "decode_s", "sample_s",
+                    "gather_s", "publish_s",
+                ),
                 0.0,
             )
             self._steps_seen = 0
@@ -286,11 +299,21 @@ class _ServingMetrics:
         """One ``pull_prefix`` attempt: outcome ok (imported >= 1 block),
         empty (nothing to pull — no hashes, or peer had no warm blocks),
         skipped (never attempted: deadline budget exhausted or the pod is
-        shutting down — the overload signal, kept distinct from empty), or
-        failed (fetch/import error, fell back to cold)."""
+        shutting down — the overload signal, kept distinct from empty),
+        failed (fetch/import error, fell back to cold), or canceled (the
+        sequence died while an async fetch was in flight)."""
         if self._prom is None or not self._obs:
             return
         self.transfer_pull.labels(outcome=outcome).observe(seconds)
+
+    def observe_pull_overlap(self, hidden_s: float, exposed_s: float) -> None:
+        """One async pull's wall-time split: ``hidden`` = before the
+        scheduler first wanted the sequence (overlapped with other work),
+        ``exposed`` = the remainder (it held this sequence's prefill)."""
+        if self._prom is None or not self._obs:
+            return
+        self.pull_overlap.labels(kind="hidden").observe(max(hidden_s, 0.0))
+        self.pull_overlap.labels(kind="exposed").observe(max(exposed_s, 0.0))
 
     def sync_step_stats(self, step_stats: dict, lag_s: Optional[float]) -> None:
         """Mirror the engine's cumulative step-phase seconds into the
@@ -499,6 +522,19 @@ class PodServerConfig:
     transfer_max_blocks: int = 64
     #: fetch deadline; an expired pull falls back to cold prefill
     transfer_timeout_s: float = 10.0
+    #: async prefix import (``ASYNC_PULL``): a pull-routed request enters
+    #: the waiting queue in an ``importing`` state while a worker thread
+    #: fetches + verifies the chain in the background; the scheduler
+    #: admits it only once the imported blocks land (or the fetch fails —
+    #: cold-prefill fallback preserved), so decode batches and later
+    #: arrivals never stall on the wire. Off (default) = the legacy
+    #: blocking ``pull_prefix``-then-submit flow, bit-identical.
+    async_pull: bool = False
+    #: import worker threads for ASYNC_PULL — bounds concurrent in-flight
+    #: fetches (each holds one DEALER socket + one staged import). Size to
+    #: the expected concurrent pull-routed admissions; see
+    #: docs/operations.md.
+    pull_workers: int = 2
     # -- fleet self-healing (all off by default = bit-identical legacy) ----
     #: seconds between Heartbeat events (liveness beacon + publisher drop
     #: report for the indexer's dead-pod sweep); 0 = no heartbeats.
@@ -566,6 +602,8 @@ class PodServerConfig:
         cfg.transfer_timeout_s = float(
             os.environ.get("TRANSFER_TIMEOUT_S", cfg.transfer_timeout_s)
         )
+        cfg.async_pull = _env_bool("ASYNC_PULL", "0")
+        cfg.pull_workers = int(os.environ.get("PULL_WORKERS", cfg.pull_workers))
         # Fleet self-healing (0/unset = off, legacy behavior).
         cfg.heartbeat_interval_s = float(
             os.environ.get("HEARTBEAT_INTERVAL_S", cfg.heartbeat_interval_s)
@@ -650,6 +688,11 @@ class PodServerConfig:
         # Pipeline fused-decode bursts (host/device overlap); needs
         # DECODE_STEPS_PER_ITER > 1 to take effect.
         eng.decode_pipeline = _env_bool("DECODE_PIPELINE", "0")
+        # Device-resident decode fast path: last-token ids/lengths stay on
+        # device across steps at any burst width, and the sampled-token
+        # device_get becomes one async transfer overlapping the next
+        # dispatch. Off = bit-identical legacy decode.
+        eng.decode_fused_sampling = _env_bool("DECODE_FUSED_SAMPLING", "0")
         # Speculative decoding ("off" | "prompt_lookup") + its knobs.
         eng.spec_decode = os.environ.get("SPEC_DECODE", eng.spec_decode)
         eng.spec_k = int(os.environ.get("SPEC_K", eng.spec_k))
@@ -728,8 +771,8 @@ class PodServer:
         #: without any lock and enqueueing never waits on device compute.
         self._mu = threading.Lock()
         self._work = threading.Condition(self._mu)
-        #: staged request tuples:
-        #: (tokens, sampling, deadline, rid, future, span, route_action)
+        #: staged request tuples: (tokens, sampling, deadline, rid,
+        #: future, span, route_action, pull_source)
         self._staging: deque[tuple] = deque()
         self._futures: dict[int, Future] = {}  # loop-thread-only
         #: staged aborts: (request_id | None = all, future -> bool)
@@ -769,6 +812,19 @@ class PodServer:
         self._transfer_service: Optional[KVTransferService] = None
         self.transfer_pulls = 0  # pulls that imported >= 1 block
         self.transfer_pull_failures = 0  # fetch/import fell back to cold
+        # -- async prefix import (ASYNC_PULL; off = nothing below runs) -----
+        #: worker pool for background fetches (built lazily on first use)
+        self._pull_pool = None
+        #: live import jobs, seq_id -> {"cancel": Event, ...} (under _mu) —
+        #: abort/resolve flips "cancel" so a fetch landing after the
+        #: sequence died installs nothing.
+        self._pull_jobs: dict[int, dict] = {}
+        #: completed imports staged for the engine loop (the only thread
+        #: allowed to clear ``Sequence.importing``)
+        self._import_dones: deque[Sequence] = deque()
+        self.async_pulls = 0  # async imports that landed >= 1 block
+        self.async_pull_fallbacks = 0  # fetch failed/expired -> cold prefill
+        self.async_pull_canceled = 0  # sequence died while fetch in flight
 
         # -- fleet self-healing (heartbeats + periodic resync) --------------
         # Digest reads hop onto the engine loop like exports/imports: page
@@ -896,6 +952,15 @@ class PodServer:
             self._self_heal_thread = None
         if self._transfer_service is not None:
             self._transfer_service.shutdown()
+        with self._mu:
+            pool, self._pull_pool = self._pull_pool, None
+            for job in self._pull_jobs.values():
+                job["cancel"].set()
+        if pool is not None:
+            # Workers unwind on their own (fetch timeouts are bounded and
+            # submit_import fails fast once _running flips); don't block
+            # shutdown on a slow peer.
+            pool.shutdown(wait=False)
         with self._work:
             self._running = False
             self._work.notify_all()
@@ -925,9 +990,14 @@ class PodServer:
             self._transfer_exports.clear()
             self._transfer_imports.clear()
             self._digest_requests.clear()
+            self._import_dones.clear()
+            jobs = list(self._pull_jobs.values())
+            self._pull_jobs.clear()
             self._pending = 0
             self._pending_tokens = 0
-        for _, _, _, _, fut, span, _ in staged:
+        for job in jobs:
+            job["cancel"].set()
+        for _, _, _, _, fut, span, _, _ in staged:
             span.set_attr("error", str(exc))
             span.end()
             if not fut.done():
@@ -953,6 +1023,15 @@ class PodServer:
     def _resolve(self, seq: Sequence) -> None:
         """Resolve a finished/aborted sequence's future and release its
         admission accounting (engine loop only)."""
+        with self._mu:
+            job = self._pull_jobs.pop(seq.seq_id, None)
+        if job is not None:
+            # Aborted/shed while its async import was in flight: the fetch
+            # cannot be recalled off the wire, but cancel ensures the
+            # worker installs nothing when it lands — pages stay at
+            # baseline (the PR 4 abort-accounting contract, extended to
+            # the importing state).
+            job["cancel"].set()
         self.metrics.observe_finished(seq)
         if seq.trace_span is not None:
             self._emit_request_spans(seq)
@@ -1014,13 +1093,18 @@ class PodServer:
         try:
             while True:
                 with self._work:
+                    # has_ready_work, not has_work: an engine whose only
+                    # work is waiting on an in-flight async import parks
+                    # here (woken by the import-done notify) instead of
+                    # busy-spinning no-op steps against the wire.
                     while self._running and not (
                         self._staging
                         or self._aborts
                         or self._transfer_exports
                         or self._transfer_imports
                         or self._digest_requests
-                        or self.engine.has_work
+                        or self._import_dones
+                        or self.engine.has_ready_work
                     ):
                         self._work.wait(timeout=0.1)
                     if not self._running:
@@ -1035,6 +1119,8 @@ class PodServer:
                     self._transfer_imports.clear()
                     digests = list(self._digest_requests)
                     self._digest_requests.clear()
+                    import_dones = list(self._import_dones)
+                    self._import_dones.clear()
                 # Engine state is owned by this thread — no lock held while
                 # admitting or stepping (device compute can take a while).
                 # Imports land before admissions so a request staged with
@@ -1056,7 +1142,13 @@ class PodServer:
                         )
                     except Exception as e:
                         fut.set_exception(e)
-                for tokens, sampling, deadline, rid, fut, span, action in staged:
+                # Import completions clear `importing` HERE (the flag is
+                # scheduler-read state, engine-loop-owned): the sequence
+                # becomes admittable the very step its warm pages are
+                # committed.
+                for seq in import_dones:
+                    seq.importing = False
+                for tokens, sampling, deadline, rid, fut, span, action, pull in staged:
                     try:
                         seq = self.engine.add_request(
                             tokens, sampling, request_id=rid, deadline=deadline
@@ -1075,6 +1167,8 @@ class PodServer:
                     seq.trace_span = span if span.context is not None else None
                     seq.route_action = action
                     self._futures[seq.seq_id] = fut
+                    if pull is not None:
+                        self._start_async_pull(seq, pull, span)
                 # Aborts AFTER admissions: a submit-then-abort staged in
                 # the same drain cycle must find its sequence in the engine.
                 for rid, afut in aborts:
@@ -1096,7 +1190,7 @@ class PodServer:
                     self.metrics.sync_lifecycle_stats(
                         self.engine.lifecycle_stats
                     )
-                if self.engine.has_work:
+                if self.engine.has_ready_work:
                     obs = self.config.obs_metrics
                     if obs:
                         t_start = time.perf_counter()
@@ -1144,7 +1238,7 @@ class PodServer:
                     )
                     if obs:
                         self._loop_prev_end = time.perf_counter()
-                        self._loop_had_work = self.engine.has_work
+                        self._loop_had_work = self.engine.has_ready_work
                         sch = self.engine.scheduler
                         self.metrics.sync_step_stats(
                             self.engine.step_stats, self._loop_lag_s
@@ -1285,6 +1379,176 @@ class PodServer:
             self._work.notify()
         return fut
 
+    def _get_client(self, endpoint: str) -> Optional[KVTransferClient]:
+        """Per-peer transfer client (created lazily, breaker-configured).
+        None when the pod is shutting down — a client created after the
+        shutdown sweep would leak its socket."""
+        with self._mu:  # races shutdown's client sweep
+            if not self._running:
+                return None
+            client = self._transfer_clients.get(endpoint)
+            if client is None:
+                client = KVTransferClient(
+                    TransferClientConfig(
+                        endpoint=endpoint,
+                        timeout_s=self.config.transfer_timeout_s,
+                        breaker_failures=self.config.transfer_breaker_failures,
+                        breaker_backoff_s=self.config.transfer_breaker_backoff_s,
+                        breaker_backoff_max_s=(
+                            self.config.transfer_breaker_backoff_max_s
+                        ),
+                    ),
+                    on_sample=self._observe_transfer_sample,
+                )
+                self._transfer_clients[endpoint] = client
+        return client
+
+    # -- async prefix import (ASYNC_PULL) -----------------------------------
+    def _start_async_pull(self, seq: Sequence, source: str, span) -> None:
+        """Flip a just-admitted sequence into the ``importing`` state and
+        hand its prefix fetch to the worker pool (engine loop only). The
+        scheduler skips the sequence — admitting later arrivals past it —
+        until ``_finish_async_pull`` clears the flag."""
+        job = {"cancel": threading.Event(), "source": source}
+        with self._mu:
+            if not self._running:
+                # Racing shutdown: skip the pull entirely — the sequence
+                # stays admittable (cold) and _fail_outstanding resolves
+                # its future; a pool touched here may already be torn down.
+                return
+            if self._pull_pool is None:
+                self._pull_pool = ThreadPoolExecutor(
+                    max_workers=max(self.config.pull_workers, 1),
+                    thread_name_prefix="kv-pull",
+                )
+            pool = self._pull_pool
+            self._pull_jobs[seq.seq_id] = job
+        seq.importing = True
+        trace_ctx = span.context if span is not None else None
+        try:
+            pool.submit(self._async_pull_worker, seq, source, job, trace_ctx)
+        except RuntimeError:  # executor shut down between the lock and here
+            seq.importing = False
+            with self._mu:
+                self._pull_jobs.pop(seq.seq_id, None)
+
+    def _finish_async_pull(self, seq: Sequence, job: dict) -> None:
+        """Stage the import completion back onto the engine loop (the only
+        thread allowed to clear ``importing``) and wake it."""
+        with self._work:
+            self._pull_jobs.pop(seq.seq_id, None)
+            if self._running:
+                self._import_dones.append(seq)
+                self._work.notify()
+            else:
+                seq.importing = False  # loop gone; unblock directly
+
+    def _async_pull_worker(self, seq: Sequence, source: str, job, trace_ctx) -> None:
+        """Background prefix import for one sequence (worker thread):
+        fetch the warm chain from ``source``, verify + install it via the
+        engine-loop import path, then release the sequence to the
+        scheduler. EVERY exit — success, empty peer, fetch timeout, wire
+        error, cancel — releases the sequence; failure means cold prefill,
+        never a stuck or failed request. The fetch timeout is clamped to
+        the request's remaining deadline budget, and a tripped per-peer
+        breaker fails the fetch instantly (one skipped fetch, not one
+        timeout). The ``pod.pull_prefix`` span gains async/overlap attrs:
+        ``overlap`` is the share of the pull hidden behind other work
+        (before the scheduler first wanted this sequence)."""
+        span = self.tracer.start_span(
+            "pod.pull_prefix",
+            parent=trace_ctx,
+            attrs={
+                "source": source,
+                "pod": self.config.pod_identifier,
+                "async": True,
+            },
+        )
+        t0 = time.monotonic()
+        imported = 0
+        outcome = "failed"
+        try:
+            fetch_timeout: Optional[float] = None
+            wait_timeout = self.config.transfer_timeout_s * 3
+            if seq.deadline is not None:
+                remaining = seq.deadline - t0
+                if remaining <= 0:
+                    outcome = "skipped"
+                    return
+                fetch_timeout = min(self.config.transfer_timeout_s, remaining)
+                wait_timeout = min(wait_timeout, remaining)
+            hashes = self.engine.block_manager.token_db.prefix_hashes(
+                seq.prompt_tokens
+            )
+            if not hashes:
+                outcome = "empty"
+                return
+            client = self._get_client(source)
+            if client is None or job["cancel"].is_set():
+                outcome = "skipped"
+                return
+            blocks, _complete = client.fetch(
+                self.config.model_name,
+                hashes,
+                self.config.transfer_max_blocks,
+                timeout_s=fetch_timeout,
+                traceparent=(
+                    format_traceparent(span.context)
+                    if span.context is not None
+                    else None
+                ),
+            )
+            if job["cancel"].is_set():
+                # The sequence died (abort/shed) while the bytes were in
+                # flight: install nothing — pages stay at baseline.
+                outcome = "canceled"
+                return
+            imported = (
+                self.submit_import(blocks).result(timeout=wait_timeout)
+                if blocks
+                else 0
+            )
+            outcome = "ok" if imported else "empty"
+        except (TransferError, RuntimeError, FuturesTimeout) as e:
+            log.warning(
+                "async KV pull failed; sequence falls back to cold prefill",
+                source=source,
+                seq=seq.seq_id,
+                error=repr(e),
+            )
+            span.set_attr("error", repr(e))
+            outcome = "failed"
+        finally:
+            t1 = time.monotonic()
+            if outcome != "ok" and job["cancel"].is_set():
+                # The sequence died while the fetch was in flight: whatever
+                # the wire did (timed out, errored, returned nothing), this
+                # is a cancel, not a cold-prefill fallback — there is no
+                # sequence left to fall back.
+                outcome = "canceled"
+            with self._mu:  # += is not atomic; workers finish concurrently
+                if outcome == "canceled":
+                    self.async_pull_canceled += 1
+                elif imported:
+                    self.transfer_pulls += 1
+                    self.async_pulls += 1
+                elif outcome == "failed":
+                    self.transfer_pull_failures += 1
+                    self.async_pull_fallbacks += 1
+            # Overlap decomposition: time before the scheduler first
+            # wanted this sequence was hidden behind other work; the
+            # remainder exposed (it held this sequence's prefill).
+            wanted = seq.import_wanted_time
+            hidden = t1 - t0 if wanted is None else min(max(wanted - t0, 0.0), t1 - t0)
+            exposed = (t1 - t0) - hidden
+            span.set_attr("outcome", outcome)
+            span.set_attr("imported_blocks", imported)
+            span.set_attr("overlap", round(hidden, 6))
+            span.end()
+            self.metrics.observe_pull(t1 - t0, outcome)
+            self.metrics.observe_pull_overlap(hidden, exposed)
+            self._finish_async_pull(seq, job)
+
     def pull_prefix(
         self,
         prompt_tokens: list[int],
@@ -1333,25 +1597,9 @@ class PodServer:
         hashes = self.engine.block_manager.token_db.prefix_hashes(prompt_tokens)
         if not hashes:
             return done(0, "empty")
-        with self._mu:  # pull_prefix races shutdown's client sweep
-            if not self._running:
-                # a client created post-sweep would leak its socket
-                return done(0, "skipped")
-            client = self._transfer_clients.get(source_endpoint)
-            if client is None:
-                client = KVTransferClient(
-                    TransferClientConfig(
-                        endpoint=source_endpoint,
-                        timeout_s=self.config.transfer_timeout_s,
-                        breaker_failures=self.config.transfer_breaker_failures,
-                        breaker_backoff_s=self.config.transfer_breaker_backoff_s,
-                        breaker_backoff_max_s=(
-                            self.config.transfer_breaker_backoff_max_s
-                        ),
-                    ),
-                    on_sample=self._observe_transfer_sample,
-                )
-                self._transfer_clients[source_endpoint] = client
+        client = self._get_client(source_endpoint)
+        if client is None:
+            return done(0, "skipped")
         try:
             blocks, _complete = client.fetch(
                 self.config.model_name,
@@ -1441,6 +1689,7 @@ class PodServer:
         request_id: Optional[str] = None,
         trace_ctx=None,
         route_action: Optional[str] = None,
+        pull_source: Optional[str] = None,
     ) -> Future:
         """Enqueue a request; the Future resolves to the finished Sequence
         (or raises: invalid request, engine failure, shutdown). Raises
@@ -1453,7 +1702,14 @@ class PodServer:
         parent for this request's spans — with tracing enabled the pod
         mints its own trace when None. ``route_action``: the router's
         verdict ("route_warm"/"pull"/"cold") labeling the latency
-        histograms; None derives warm/cold from the prefix-cache hit."""
+        histograms; None derives warm/cold from the prefix-cache hit.
+        ``pull_source``: a peer pod's transfer endpoint whose warm prefix
+        should be imported for this request. Honored only with
+        ``async_pull`` on: the request enters the queue ``importing`` and
+        a worker fetches the chain in the background (the scheduler
+        admits it once the blocks land, or on any fetch failure — cold
+        prefill). With the knob off the argument is ignored; callers use
+        the legacy blocking ``pull_prefix``-then-``submit`` flow."""
         # Surface obviously-bad requests synchronously with the same checks
         # add_request applies (the rest raise through the Future).
         if not prompt_tokens:
@@ -1495,9 +1751,14 @@ class PodServer:
             fut.trace_context = span.context
             self._pending += 1
             self._pending_tokens += len(prompt_tokens)
+            pull = (
+                pull_source
+                if pull_source and self.config.async_pull
+                else None
+            )
             self._staging.append(
                 (list(prompt_tokens), sampling, deadline, rid, fut, span,
-                 route_action)
+                 route_action, pull)
             )
             self._work.notify()
         return fut
@@ -1607,6 +1868,15 @@ class PodServer:
             route_action = request.headers.get("X-Route-Action")
             if route_action not in ("route_warm", "pull", "cold"):
                 route_action = None
+            # Async prefix import: the router names the warm peer in
+            # X-Pull-Source and this pod fetches in the background while
+            # the request queues. Read only when ASYNC_PULL is on — the
+            # knobs-off request path touches no headers it didn't before.
+            pull_source = (
+                request.headers.get("X-Pull-Source")
+                if self.config.async_pull
+                else None
+            )
             try:
                 fut = self.submit(
                     token_ids,
@@ -1614,6 +1884,7 @@ class PodServer:
                     deadline_s=deadline_s,
                     trace_ctx=trace_ctx,
                     route_action=route_action,
+                    pull_source=pull_source,
                 )
             except AdmissionError as e:  # overloaded: fast 429, engine untouched
                 retry_after = max(int(-(-e.retry_after_s // 1)), 1)
@@ -1784,6 +2055,18 @@ class PodServer:
                     "forced_requests": self.drain_forced_requests,
                 },
             }
+            if self.config.async_pull:
+                # Async-import block only when the knob is on: the
+                # knobs-off /stats payload stays bit-identical.
+                with self._mu:
+                    importing = len(self._pull_jobs)
+                payload["transfer"]["async_pull"] = {
+                    "workers": self.config.pull_workers,
+                    "importing": importing,
+                    "pulls": self.async_pulls,
+                    "fallbacks": self.async_pull_fallbacks,
+                    "canceled": self.async_pull_canceled,
+                }
             if bm.config.host_pages > 0:
                 # Host tier + KV quant block only when the tier knob is on:
                 # the knobs-off /stats payload stays bit-identical.

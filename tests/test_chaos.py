@@ -825,3 +825,49 @@ class TestEngineFleetChaos:
             )
         finally:
             self._teardown(pool, servers)
+
+
+class TestAsyncPullChaos:
+    """ISSUE 7 satellite: a delayed/partitioned transfer peer under
+    ASYNC_PULL=1 must never stall decode for unrelated sequences, and the
+    importing sequence must fall back to cold prefill with identical
+    greedy output."""
+
+    def test_partitioned_peer_stalls_nothing_and_falls_back_cold(self):
+        from conftest import free_tcp_port
+
+        cold = PodServer(
+            _pod_config("apc-cold", async_pull=True, transfer_timeout_s=20.0)
+        )
+        ref = PodServer(_pod_config("apc-ref"))
+        cold.start(), ref.start()
+        try:
+            # An unrelated request is mid-decode when the pull-routed
+            # request arrives pointing at a partitioned peer (nobody
+            # home: the fetch hangs until the 20 s poll deadline —
+            # generous so a first-run jit compile of the decode shapes
+            # can never outlast it and flake the not-done assert).
+            running = cold.submit(
+                _prompt(40, 8), SamplingParams(max_new_tokens=12)
+            )
+            prompt = _prompt(41, 12)
+            peer = f"tcp://127.0.0.1:{free_tcp_port()}"
+            stalled = cold.submit(
+                prompt, SamplingParams(max_new_tokens=4), pull_source=peer
+            )
+            # The running lane finishes all 12 tokens while the import is
+            # still on the wire — decode ITL never saw the partition.
+            s_run = running.result(timeout=120)
+            assert len(s_run.generated_tokens) == 12
+            assert not stalled.done()
+            assert cold._pull_jobs  # the fetch really is still in flight
+
+            s = stalled.result(timeout=120)  # poll deadline -> cold prefill
+            s_ref = ref.generate(
+                prompt, SamplingParams(max_new_tokens=4), timeout=120
+            )
+            assert s.generated_tokens == s_ref.generated_tokens
+            assert s.num_cached_prompt == 0
+            assert cold.async_pull_fallbacks == 1
+        finally:
+            cold.shutdown(), ref.shutdown()

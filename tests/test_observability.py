@@ -217,6 +217,8 @@ _POD_OBS_METRICS = {
     "kvcache_request_queue_seconds": "histogram",
     "kvcache_request_e2e_seconds": "histogram",
     "kvcache_transfer_pull_seconds": "histogram",
+    # Async KV-pull overlap decomposition (ISSUE 7)
+    "kvcache_transfer_pull_overlap_seconds": "histogram",
     "kvcache_engine_steps_total": "counter",
     "kvcache_engine_step_phase_seconds_total": "counter",
     "kvcache_engine_batch_occupancy": "gauge",
@@ -330,13 +332,38 @@ class TestLatencyDecomposition:
         pytest.importorskip("prometheus_client")
         m = _ServingMetrics(obs=True)
         stats = {"steps": 2, "schedule_s": 0.5, "prefill_s": 1.0,
-                 "decode_s": 0.25, "gather_s": 0.0, "publish_s": 0.125}
+                 "decode_s": 0.25, "sample_s": 0.0625, "gather_s": 0.0,
+                 "publish_s": 0.125}
         m.sync_step_stats(stats, lag_s=0.01)
         m.sync_step_stats(stats, lag_s=0.01)  # no double count
         text = m.exposition().decode()
         assert "kvcache_engine_steps_total 2.0" in text
         assert 'kvcache_engine_step_phase_seconds_total{phase="prefill"} 1.0' in text
+        # The decode fast path's fusion evidence: the blocking share of
+        # the sampled-token fetch is its own phase.
+        assert (
+            'kvcache_engine_step_phase_seconds_total{phase="sample"} 0.0625'
+            in text
+        )
         assert "kvcache_engine_loop_lag_seconds 0.01" in text
+
+    def test_pull_overlap_histogram_kinds(self):
+        pytest.importorskip("prometheus_client")
+        m = _ServingMetrics(obs=True)
+        m.observe_pull_overlap(0.4, 0.1)
+        text = m.exposition().decode()
+        assert (
+            'kvcache_transfer_pull_overlap_seconds_count{kind="hidden"} 1.0'
+            in text
+        )
+        assert (
+            'kvcache_transfer_pull_overlap_seconds_count{kind="exposed"} 1.0'
+            in text
+        )
+        assert (
+            'kvcache_transfer_pull_overlap_seconds_sum{kind="hidden"} 0.4'
+            in text
+        )
 
 
 class TestTransferWireParity:
