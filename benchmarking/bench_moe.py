@@ -78,7 +78,7 @@ def main() -> int:
         )
         outs = {}
         for name, c in variants:
-            fn = jax.jit(lambda l, v, c=c: _moe_mlp(l, c, v))
+            fn = jax.jit(lambda p, v, c=c: _moe_mlp(p, c, v))
             compiled = fn.lower(layer, x).compile()
             an = compiled.cost_analysis()
             an = an[0] if isinstance(an, list) else an

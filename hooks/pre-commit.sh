@@ -4,9 +4,12 @@ set -euo pipefail
 cd "$(git rev-parse --show-toplevel)"
 
 echo "[pre-commit] syntax check"
-python -m compileall -q llm_d_kv_cache_manager_tpu tests examples
+python -m compileall -q llm_d_kv_cache_manager_tpu tests examples tools
 
-echo "[pre-commit] fast tests (routing core)"
-JAX_PLATFORMS=cpu python -m pytest \
+echo "[pre-commit] kvlint (repo invariants)"
+python -m tools.kvlint llm_d_kv_cache_manager_tpu/
+
+echo "[pre-commit] fast tests (routing core + lock-order harness)"
+JAX_PLATFORMS=cpu LOCKTRACE=1 python -m pytest \
     tests/test_token_processor.py tests/test_index_backends.py \
-    tests/test_scorer.py tests/test_kvevents.py -q -x
+    tests/test_scorer.py tests/test_kvevents.py tests/test_kvlint.py -q -x

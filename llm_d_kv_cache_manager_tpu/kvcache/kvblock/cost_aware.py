@@ -38,17 +38,17 @@ class CostAwareMemoryIndex(Index):
         self.config = config or CostAwareMemoryIndexConfig()
         if self.config.max_cost_bytes < 1:
             raise ValueError("max_cost_bytes must be >= 1")
-        self._data: OrderedDict[Key, set[PodEntry]] = OrderedDict()
-        self._costs: dict[Key, int] = {}
-        self._total_cost = 0
         self._lock = threading.RLock()
+        self._data: OrderedDict[Key, set[PodEntry]] = OrderedDict()  # guarded_by: _lock
+        self._costs: dict[Key, int] = {}  # guarded_by: _lock
+        self._total_cost = 0  # guarded_by: _lock
 
     @property
     def total_cost(self) -> int:
         with self._lock:
             return self._total_cost
 
-    def _recost(self, key: Key) -> None:
+    def _recost(self, key: Key) -> None:  # kvlint: holds=_lock
         """Recompute a key's charge and evict LRU keys while over budget."""
         new_cost = estimate_entry_cost(key, self._data[key])
         self._total_cost += new_cost - self._costs.get(key, 0)

@@ -41,9 +41,9 @@ class NativeMemoryIndex(Index):
         self._idx = _native.NativeLru(self.config.size, self.config.pod_cache_size)
         # Intern tables. Pods and models are few (fleet-sized); u32 is ample.
         self._mu = threading.Lock()
-        self._model_ids: dict[str, int] = {}
-        self._pod_ids: dict[str, int] = {}
-        self._pod_names: list[str] = []
+        self._model_ids: dict[str, int] = {}  # guarded_by: _mu
+        self._pod_ids: dict[str, int] = {}  # guarded_by: _mu
+        self._pod_names: list[str] = []  # guarded_by: _mu
 
     # -- interning ----------------------------------------------------------
     def _model_id(self, name: str, *, create: bool) -> Optional[int]:

@@ -21,7 +21,7 @@ is the audited fallback and the parity oracle for tests.
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from .cbor import dumps_canonical

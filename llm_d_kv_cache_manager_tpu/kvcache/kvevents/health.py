@@ -86,14 +86,14 @@ class FleetHealth:
         self.config = config or FleetHealthConfig()
         self._clock = clock
         self._mu = threading.Lock()
-        self._pods: dict[str, _PodState] = {}
+        self._pods: dict[str, _PodState] = {}  # guarded_by: _mu
         # Monotone counters (mirrored into the metrics collector).
-        self.gaps_detected = 0
-        self.resyncs_applied = 0
-        self.pods_swept = 0
-        self.heartbeats_seen = 0
-        self.publisher_drops_reported = 0
-        self.pods_drained = 0
+        self.gaps_detected = 0  # guarded_by: _mu
+        self.resyncs_applied = 0  # guarded_by: _mu
+        self.pods_swept = 0  # guarded_by: _mu
+        self.heartbeats_seen = 0  # guarded_by: _mu
+        self.publisher_drops_reported = 0  # guarded_by: _mu
+        self.pods_drained = 0  # guarded_by: _mu
         self._sweep_thread: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
 
@@ -272,16 +272,19 @@ class FleetHealth:
                 }
                 for pod, st in self._pods.items()
             }
-        return {
-            "pod_ttl_s": self.config.pod_ttl_s,
-            "gaps_detected": self.gaps_detected,
-            "resyncs_applied": self.resyncs_applied,
-            "pods_swept": self.pods_swept,
-            "heartbeats_seen": self.heartbeats_seen,
-            "publisher_drops_reported": self.publisher_drops_reported,
-            "pods_drained": self.pods_drained,
-            "pods": pods,
-        }
+            # Counters read under the same lock as the per-pod state so one
+            # scrape is a consistent cut (found by kvlint lock-discipline:
+            # the unguarded reads could pair a new counter with old state).
+            return {
+                "pod_ttl_s": self.config.pod_ttl_s,
+                "gaps_detected": self.gaps_detected,
+                "resyncs_applied": self.resyncs_applied,
+                "pods_swept": self.pods_swept,
+                "heartbeats_seen": self.heartbeats_seen,
+                "publisher_drops_reported": self.publisher_drops_reported,
+                "pods_drained": self.pods_drained,
+                "pods": pods,
+            }
 
     # -- dead-pod sweeper ----------------------------------------------------
     def sweep(self, index: Index) -> list[str]:

@@ -16,9 +16,9 @@ from __future__ import annotations
 import queue
 import threading
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Optional
 
-from ...utils import get_logger
+from ...utils import RateLimitedWarn, get_logger
 from ..kvblock import DeviceTier, Index, Key, PodEntry, tier_for_medium
 from .events import (
     AllBlocksCleared,
@@ -36,6 +36,9 @@ if TYPE_CHECKING:  # avoids a runtime import cycle with health.py
     from .health import FleetHealth
 
 log = get_logger("kvcache.kvevents.pool")
+#: index-backend faults repeat at the event rate when a backend degrades;
+#: warn with a suppressed-repeat count instead of one line per event.
+_warn = RateLimitedWarn(log)
 
 DEFAULT_CONCURRENCY = 4
 
@@ -87,17 +90,18 @@ class KVEventsPool:
             raise ValueError("concurrency must be >= 1")
         self.index = index
         self.health = health
+        self._mu = threading.Lock()
         #: tasks rejected because the pool was already shut down — after the
         #: poison pill a task would sit unprocessed forever, which is worse
         #: than an honest drop (the index self-heals via resync anyway).
-        self.rejected_after_shutdown = 0
+        self.rejected_after_shutdown = 0  # guarded_by: _mu
+        #: immutable after construction; workers index it lock-free
         self._queues: list["queue.Queue[Optional[Message]]"] = [
             queue.Queue() for _ in range(self.config.concurrency)
         ]
-        self._threads: list[threading.Thread] = []
-        self._running = False
-        self._started = False
-        self._mu = threading.Lock()
+        self._threads: list[threading.Thread] = []  # guarded_by: _mu
+        self._running = False  # guarded_by: _mu
+        self._started = False  # guarded_by: _mu
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -131,8 +135,10 @@ class KVEventsPool:
         """Block until all queued *and in-flight* events have been applied."""
         import time
 
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # Deadline math on the monotonic clock: a wall-clock (time.time)
+        # deadline steps under NTP slew and can wait forever or not at all.
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if all(q.unfinished_tasks == 0 for q in self._queues):
                 return True
             time.sleep(0.002)
@@ -163,9 +169,17 @@ class KVEventsPool:
             try:
                 self._process_event(msg)
             except Exception:
-                # Poison pill or backend failure on one message must not kill
-                # the worker; drop and continue (reference pool.go:174-180).
-                log.exception("failed to process event message; dropping")
+                # Deliberately broad: ANY failure on one message must not
+                # kill the worker thread — a dead shard silently stops
+                # applying its pods' events forever. Rate-limited so a
+                # poison storm stays one WARN per interval, not one per
+                # message (reference pool.go:174-180).
+                _warn.warning(
+                    f"worker-{shard}",
+                    "failed to process event message; dropping",
+                    exc_info=True,
+                    pod=msg.pod_identifier,
+                )
             finally:
                 q.task_done()
 
@@ -188,7 +202,14 @@ class KVEventsPool:
                 try:
                     self.index.add(keys, entries)
                 except Exception:
-                    log.exception("failed to add event to index", pod=msg.pod_identifier)
+                    # Backend-specific fault zoo (redis I/O, native index,
+                    # lru) — broad by necessity, loud by rate-limited WARN.
+                    _warn.warning(
+                        "index-add",
+                        "failed to add event to index",
+                        exc_info=True,
+                        pod=msg.pod_identifier,
+                    )
             elif isinstance(ev, BlockRemoved):
                 if ev.medium is None:
                     # No medium (incl. legacy events) = the pod no longer
@@ -202,7 +223,12 @@ class KVEventsPool:
                     try:
                         self.index.evict(Key(msg.model_name, h), entries)
                     except Exception:
-                        log.exception("failed to evict from index", pod=msg.pod_identifier)
+                        _warn.warning(
+                            "index-evict",
+                            "failed to evict from index",
+                            exc_info=True,
+                            pod=msg.pod_identifier,
+                        )
             elif isinstance(ev, Heartbeat):
                 if self.health is not None:
                     self.health.observe_heartbeat(
@@ -218,8 +244,11 @@ class KVEventsPool:
                 try:
                     self.index.evict_pod(msg.pod_identifier)
                 except Exception:
-                    log.exception(
-                        "drained-pod eviction failed", pod=msg.pod_identifier
+                    _warn.warning(
+                        "evict-pod",
+                        "drained-pod eviction failed",
+                        exc_info=True,
+                        pod=msg.pod_identifier,
                     )
                 if self.health is not None:
                     self.health.observe_drained(msg.pod_identifier)
@@ -246,7 +275,12 @@ class KVEventsPool:
         try:
             self.index.evict_pod(msg.pod_identifier)
         except Exception:
-            log.exception("resync: evict_pod failed", pod=msg.pod_identifier)
+            _warn.warning(
+                "resync-evict",
+                "resync: evict_pod failed",
+                exc_info=True,
+                pod=msg.pod_identifier,
+            )
             return
         for medium, hashes in ev.blocks_by_medium.items():
             if not hashes:
@@ -256,8 +290,10 @@ class KVEventsPool:
             try:
                 self.index.add(keys, entries)
             except Exception:
-                log.exception(
+                _warn.warning(
+                    "resync-add",
                     "resync: failed to apply snapshot tier",
+                    exc_info=True,
                     pod=msg.pod_identifier,
                     medium=medium,
                 )

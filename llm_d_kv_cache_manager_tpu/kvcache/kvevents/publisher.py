@@ -46,10 +46,10 @@ class ZMQPublisher:
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.PUB)
         self._sock.connect(config.endpoint)
-        self._seq = 0
         self._mu = threading.Lock()
-        self._closed = False
-        self.dropped_batches = 0
+        self._seq = 0  # guarded_by: _mu
+        self._closed = False  # guarded_by: _mu
+        self.dropped_batches = 0  # guarded_by: _mu
         self.topic = f"kv@{config.pod_identifier}@{config.model_name}"
 
     def publish(self, events: list[Event], ts: Optional[float] = None) -> int:
@@ -59,7 +59,8 @@ class ZMQPublisher:
         import zmq
 
         batch = EventBatch(
-            ts=ts if ts is not None else time.time(),
+            # Wall clock on purpose: ts crosses the wire, compared across hosts.
+            ts=ts if ts is not None else time.time(),  # kvlint: disable=monotonic-time
             events=events,
             data_parallel_rank=self.config.data_parallel_rank,
         )
@@ -76,9 +77,15 @@ class ZMQPublisher:
             seq = self._seq
             self._seq += 1
             frames = [self.topic.encode("utf-8"), struct.pack(">Q", seq), payload]
+            # Send/backoff UNDER _mu on purpose: PUB sockets are not
+            # thread-safe, and releasing the lock mid-retry would let a
+            # later seq overtake this one on the wire — subscribers would
+            # read the reorder as a gap and trigger spurious resyncs.
+            # Worst case is ~0.15s (bounded retries); publish is called
+            # off the engine's hot path.
             for attempt in range(_SEND_ATTEMPTS):
                 try:
-                    self._sock.send_multipart(frames)
+                    self._sock.send_multipart(frames)  # kvlint: disable=lock-discipline
                     return seq
                 except zmq.ZMQError as e:
                     if attempt + 1 == _SEND_ATTEMPTS:
@@ -96,7 +103,7 @@ class ZMQPublisher:
                             dropped_total=self.dropped_batches,
                         )
                         return -1
-                    time.sleep(_SEND_BACKOFF_S * (2**attempt))
+                    time.sleep(_SEND_BACKOFF_S * (2**attempt))  # kvlint: disable=lock-discipline
         return -1  # unreachable; keeps the contract explicit
 
     def close(self) -> None:

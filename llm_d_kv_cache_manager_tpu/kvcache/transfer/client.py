@@ -80,13 +80,13 @@ class CircuitBreaker:
         self.backoff_max_s = backoff_max_s
         self._clock = clock
         self._mu = threading.Lock()
-        self._state = self.CLOSED
-        self._failures = 0  # consecutive
-        self._backoff_s = backoff_s
-        self._opened_at = 0.0
-        self._probe_inflight = False
-        self.opens = 0
-        self.closes = 0
+        self._state = self.CLOSED  # guarded_by: _mu
+        self._failures = 0  # consecutive  # guarded_by: _mu
+        self._backoff_s = backoff_s  # guarded_by: _mu
+        self._opened_at = 0.0  # guarded_by: _mu
+        self._probe_inflight = False  # guarded_by: _mu
+        self.opens = 0  # guarded_by: _mu
+        self.closes = 0  # guarded_by: _mu
 
     @property
     def state(self) -> str:
@@ -184,19 +184,21 @@ class KVTransferClient:
             )
         self.breaker_skips = 0  # fetches rejected instantly by an open breaker
         self._mu = threading.Lock()
-        self._sock = None
-        self._closed = False
+        self._sock = None  # guarded_by: _mu
+        self._closed = False  # guarded_by: _mu
 
-    def _socket(self):
+    def _socket(self):  # kvlint: holds=_mu
         import zmq
 
         if self._sock is None:
             ctx = zmq.Context.instance()
             self._sock = ctx.socket(zmq.DEALER)
-            self._sock.connect(self.config.endpoint)
+            # zmq connect is asynchronous (registers the endpoint with the
+            # io thread; no handshake wait), so it cannot convoy the lock.
+            self._sock.connect(self.config.endpoint)  # kvlint: disable=lock-discipline
         return self._sock
 
-    def _reset_socket(self) -> None:
+    def _reset_socket(self) -> None:  # kvlint: holds=_mu
         if self._sock is not None:
             self._sock.close(linger=0)
             self._sock = None
@@ -268,7 +270,12 @@ class KVTransferClient:
                         f"fetch timed out after {deadline_s}s "
                         f"({self.config.endpoint})"
                     )
-                frames = sock.recv_multipart()
+                # Recv under _mu on purpose: ZMQ sockets are not thread-safe
+                # and the reply must pair with its request (a second sender
+                # interleaving on this DEALER would cross the streams).
+                # Blocking is bounded by the poll() deadline above; fetch
+                # concurrency comes from one client per pull worker.
+                frames = sock.recv_multipart()  # kvlint: disable=lock-discipline
             except zmq.ZMQError as e:
                 self._reset_socket()
                 raise TransferError(f"fetch failed: {e}") from e

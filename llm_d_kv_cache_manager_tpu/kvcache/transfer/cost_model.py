@@ -55,8 +55,8 @@ class TransferCostModel:
             raise ValueError("block_bytes must be >= 1")
         self.config = config
         self._mu = threading.Lock()
-        self._transfer_rate: Optional[float] = None  # bytes / s
-        self._prefill_rate: Optional[float] = None  # tokens / s
+        self._transfer_rate: Optional[float] = None  # bytes/s  # guarded_by: _mu
+        self._prefill_rate: Optional[float] = None  # tokens/s  # guarded_by: _mu
 
     # -- measured-rate feeds ------------------------------------------------
     @staticmethod
@@ -94,11 +94,13 @@ class TransferCostModel:
 
     @property
     def transfer_rate(self) -> Optional[float]:
-        return self._transfer_rate
+        with self._mu:
+            return self._transfer_rate
 
     @property
     def prefill_rate(self) -> Optional[float]:
-        return self._prefill_rate
+        with self._mu:
+            return self._prefill_rate
 
     # -- the decision -------------------------------------------------------
     def decide(

@@ -31,6 +31,13 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Optional
 
+from ..utils import RateLimitedWarn, get_logger
+
+log = get_logger("obs.tracing")
+#: exporter faults repeat per span once a collector misbehaves; keep them
+#: visible without the log scaling with span volume.
+_warn = RateLimitedWarn(log)
+
 _HEX = set("0123456789abcdef")
 
 
@@ -107,7 +114,9 @@ class Span:
         self.context = context
         self.parent_span_id = parent_span_id
         self.attrs = dict(attrs) if attrs else {}
-        self.start_wall = time.time()
+        # Wall clock on purpose: start_unix_s is a cross-host display/export
+        # timestamp; durations below use the monotonic pair.
+        self.start_wall = time.time()  # kvlint: disable=monotonic-time
         self.start_mono = time.monotonic()
         self.end_mono: Optional[float] = None
         self._ended = False
@@ -175,9 +184,9 @@ class Tracer:
         self.enabled = bool(enabled)
         self.service = service
         self._mu = threading.Lock()
-        self._spans: deque = deque(maxlen=max(int(max_spans), 16))
-        self.spans_recorded = 0
-        self.spans_dropped = 0
+        self._spans: deque = deque(maxlen=max(int(max_spans), 16))  # guarded_by: _mu
+        self.spans_recorded = 0  # guarded_by: _mu
+        self.spans_dropped = 0  # guarded_by: _mu
         self._otlp = None
         if self.enabled:
             endpoint = otlp_endpoint or os.environ.get("OBS_OTLP_ENDPOINT")
@@ -216,7 +225,8 @@ class Tracer:
         # Back-date: the span object was just created but the interval it
         # describes happened earlier.
         span.start_mono = start_mono
-        span.start_wall = time.time() - (time.monotonic() - start_mono)
+        # Back-dating a display timestamp: wall clock minus monotonic delta.
+        span.start_wall = time.time() - (time.monotonic() - start_mono)  # kvlint: disable=monotonic-time
         span.end(end_mono=end_mono)
 
     def _finish(self, span: Span) -> None:
@@ -239,7 +249,15 @@ class Tracer:
             try:
                 self._otlp(rec)
             except Exception:
-                self._otlp = None  # a broken exporter must not tax serving
+                # Broad by necessity (the OTLP SDK's fault surface is not
+                # enumerable); a broken exporter must not tax serving, but
+                # disabling the mirror silently left operators staring at
+                # an empty collector — say so, once.
+                log.warning(
+                    "OTLP span mirror failed; disabling for this process",
+                    exc_info=True,
+                )
+                self._otlp = None
 
     # -- reading -------------------------------------------------------------
     def traces(
@@ -347,7 +365,17 @@ def _make_otlp_exporter(endpoint: str):
             try:
                 span.set_attribute(k, v)
             except Exception:
-                pass
+                # Attribute values come from user-supplied request fields
+                # and the SDK's fault surface is not enumerable; any escape
+                # here would hit _finish's handler and disable the WHOLE
+                # mirror. Drop THAT attribute, not the span — visibly, and
+                # rate-limited per attribute key.
+                _warn.warning(
+                    f"otlp-attr:{k}",
+                    "dropping unserializable span attribute",
+                    attr=k,
+                    value_type=type(v).__name__,
+                )
         span.end(end_time=start_ns + int(rec["duration_s"] * 1e9))
 
     return export

@@ -116,7 +116,7 @@ def _flash_over_keys(
         m0, l0, acc0 = init_state
 
     def body(carry, blk):
-        m, l, acc = carry
+        m, denom, acc = carry
         kblk, vblk, vblk_valid, pblk = blk
         scores = jnp.einsum(
             "bqhgd,bhtd->bhgqt", qf, kblk.astype(jnp.float32)
@@ -129,16 +129,16 @@ def _flash_over_keys(
         m_new = jnp.maximum(m, scores.max(axis=-1))
         p = jnp.exp(scores - m_new[..., None]) * mask
         corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
+        denom = denom * corr + p.sum(axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
             "bhgqt,bhtd->bhgqd", p, vblk.astype(jnp.float32)
         )
-        return (m_new, l, acc), None
+        return (m_new, denom, acc), None
 
-    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (kb, vb, valb, posb))
+    (m, denom, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (kb, vb, valb, posb))
     if return_accumulators:
-        return m, l, acc
-    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+        return m, denom, acc
+    out = acc / jnp.where(denom > 0, denom, 1.0)[..., None]
     # [b, n_kv, g, s, d] -> [b, s, n_kv, g, d]
     return out.transpose(0, 3, 1, 2, 4)
 

@@ -179,8 +179,8 @@ def _flash_prefill_kernel(
 
     @pl.when(ks == n_ksteps - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros
+        denom = l_ref[:, :1]
+        safe_l = jnp.where(denom == 0.0, 1.0, denom)  # fully-masked rows → zeros
         out = (acc_ref[:] / safe_l).reshape(bq, group, d)
         out_ref[0, 0] = out.astype(out_ref.dtype)
 
@@ -358,8 +358,8 @@ def _flash_prefill_kernel_dma(
 
     @pl.when(ks == pl.num_programs(3) - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        denom = l_ref[:, :1]
+        safe_l = jnp.where(denom == 0.0, 1.0, denom)
         out = (acc_ref[:] / safe_l).reshape(bq, group, d)
         out_ref[0, 0] = out.astype(out_ref.dtype)
 

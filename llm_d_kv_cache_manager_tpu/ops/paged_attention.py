@@ -134,8 +134,8 @@ def _decode_kernel(
                 )
                 m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
-        l = l_ref[:, :, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)  # len-0 seq → zeros, not NaN
+        denom = l_ref[:, :, :1]
+        safe_l = jnp.where(denom == 0.0, 1.0, denom)  # len-0 seq → zeros, not NaN
         out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
 
 

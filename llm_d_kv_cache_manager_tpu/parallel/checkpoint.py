@@ -12,7 +12,7 @@ gather on restore).
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 

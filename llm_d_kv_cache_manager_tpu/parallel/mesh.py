@@ -15,7 +15,7 @@ the equivalent machinery is in-tree.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import numpy as np

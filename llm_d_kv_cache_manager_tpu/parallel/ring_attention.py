@@ -49,7 +49,7 @@ def _ring_body(carry, _, *, axis_name, qf, q_pos, scale, n_shards):
     payload to the next device on the ring."""
     from ..ops.attention import FLASH_KEY_BLOCK, _flash_over_keys
 
-    k_cur, v_cur, kpos_cur, kvalid_cur, m, l, acc = carry
+    k_cur, v_cur, kpos_cur, kvalid_cur, m, denom, acc = carry
 
     m_new, l_new, acc_new = _flash_over_keys(
         qf,
@@ -61,7 +61,7 @@ def _ring_body(carry, _, *, axis_name, qf, q_pos, scale, n_shards):
         scale,
         FLASH_KEY_BLOCK,
         return_accumulators=True,
-        init_state=(m, l, acc),
+        init_state=(m, denom, acc),
     )
 
     # Rotate K/V/pos/validity to the next device; neighbor-only ICI traffic.
@@ -132,13 +132,13 @@ def ring_attention_shard(
         scale=scale,
         n_shards=n_shards,
     )
-    (_, _, _, _, m, l, acc), _ = jax.lax.scan(
+    (_, _, _, _, m, denom, acc), _ = jax.lax.scan(
         body, (k, v, k_pos, k_valid, m0, l0, acc0), None, length=n_shards
     )
 
-    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+    out = acc / jnp.where(denom > 0, denom, 1.0)[..., None]
     # A query with no visible keys cannot happen here (it always sees
-    # itself), so no NaN guard is needed beyond the l>0 clamp.
+    # itself), so no NaN guard is needed beyond the denom>0 clamp.
     return out.transpose(0, 3, 1, 2, 4).reshape(b, s, n_q, d).astype(q.dtype)
 
 

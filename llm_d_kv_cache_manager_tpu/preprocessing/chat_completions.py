@@ -66,8 +66,8 @@ _SPECIAL_TOKEN_ATTRS = (
 
 class ChatTemplatingProcessor:
     def __init__(self):
-        self._template_cache: dict[str, tuple[str, dict[str, Any]]] = {}
         self._cache_lock = threading.Lock()
+        self._template_cache: dict[str, tuple[str, dict[str, Any]]] = {}  # guarded_by: _cache_lock
         self._initialized = False
 
     # -- lifecycle (parity with the reference's interpreter management) -----

@@ -24,7 +24,7 @@ ecosystem, designed so the routing indexer can track this engine's cache:
 from __future__ import annotations
 
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Optional, Sequence as Seq
 
 from ..kvcache.kvblock import ChunkedTokenDatabase, TokenProcessorConfig

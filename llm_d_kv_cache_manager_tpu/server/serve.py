@@ -773,22 +773,22 @@ class PodServer:
         self._work = threading.Condition(self._mu)
         #: staged request tuples: (tokens, sampling, deadline, rid,
         #: future, span, route_action, pull_source)
-        self._staging: deque[tuple] = deque()
+        self._staging: deque[tuple] = deque()  # guarded_by: _mu|_work
         self._futures: dict[int, Future] = {}  # loop-thread-only
         #: staged aborts: (request_id | None = all, future -> bool)
-        self._aborts: deque[tuple[Optional[str], Future]] = deque()
+        self._aborts: deque[tuple[Optional[str], Future]] = deque()  # guarded_by: _mu|_work
         #: admission accounting (under _mu): requests admitted by submit
         #: whose futures have not resolved yet, and their prompt tokens.
-        self._pending = 0
-        self._pending_tokens = 0
-        self.admission_rejected = 0
-        self.admission_rejected_draining = 0
+        self._pending = 0  # guarded_by: _mu|_work
+        self._pending_tokens = 0  # guarded_by: _mu|_work
+        self.admission_rejected = 0  # guarded_by: _mu|_work
+        self.admission_rejected_draining = 0  # guarded_by: _mu|_work
         #: graceful drain state
-        self._draining = False
+        self._draining = False  # guarded_by: _mu|_work
         self._drain_done = threading.Event()
         self._drain_clean: Optional[bool] = None
-        self.drains_started = 0
-        self.drain_forced_requests = 0
+        self.drains_started = 0  # guarded_by: _mu|_work
+        self.drain_forced_requests = 0  # guarded_by: _mu|_work
         self.metrics = _ServingMetrics(obs=self.config.obs_metrics)
         self._running = False
         self._failed: Optional[str] = None
@@ -806,32 +806,32 @@ class PodServer:
         # Export requests and imports stage onto the ENGINE LOOP, the only
         # thread allowed to touch page pools (the service/HTTP threads just
         # park on a Future) — same ownership rule as request admission.
-        self._transfer_exports: deque[tuple[list[int], Optional[int], Future]] = deque()
-        self._transfer_imports: deque[tuple[list, Future]] = deque()
-        self._transfer_clients: dict[str, KVTransferClient] = {}
+        self._transfer_exports: deque[tuple[list[int], Optional[int], Future]] = deque()  # guarded_by: _mu|_work
+        self._transfer_imports: deque[tuple[list, Future]] = deque()  # guarded_by: _mu|_work
+        self._transfer_clients: dict[str, KVTransferClient] = {}  # guarded_by: _mu|_work
         self._transfer_service: Optional[KVTransferService] = None
-        self.transfer_pulls = 0  # pulls that imported >= 1 block
-        self.transfer_pull_failures = 0  # fetch/import fell back to cold
+        self.transfer_pulls = 0  # pulls that imported >= 1 block  # guarded_by: _mu|_work
+        self.transfer_pull_failures = 0  # fell back to cold  # guarded_by: _mu|_work
         # -- async prefix import (ASYNC_PULL; off = nothing below runs) -----
         #: worker pool for background fetches (built lazily on first use)
-        self._pull_pool = None
-        #: live import jobs, seq_id -> {"cancel": Event, ...} (under _mu) —
+        self._pull_pool = None  # guarded_by: _mu|_work
+        #: live import jobs, seq_id -> {"cancel": Event, ...} —
         #: abort/resolve flips "cancel" so a fetch landing after the
         #: sequence died installs nothing.
-        self._pull_jobs: dict[int, dict] = {}
+        self._pull_jobs: dict[int, dict] = {}  # guarded_by: _mu|_work
         #: completed imports staged for the engine loop (the only thread
         #: allowed to clear ``Sequence.importing``)
-        self._import_dones: deque[Sequence] = deque()
-        self.async_pulls = 0  # async imports that landed >= 1 block
-        self.async_pull_fallbacks = 0  # fetch failed/expired -> cold prefill
-        self.async_pull_canceled = 0  # sequence died while fetch in flight
+        self._import_dones: deque[Sequence] = deque()  # guarded_by: _mu|_work
+        self.async_pulls = 0  # landed >= 1 block  # guarded_by: _mu|_work
+        self.async_pull_fallbacks = 0  # -> cold prefill  # guarded_by: _mu|_work
+        self.async_pull_canceled = 0  # seq died mid-fetch  # guarded_by: _mu|_work
 
         # -- fleet self-healing (heartbeats + periodic resync) --------------
         # Digest reads hop onto the engine loop like exports/imports: page
         # bookkeeping is engine-loop-owned state.
-        self._digest_requests: deque[Future] = deque()
-        self.heartbeats_published = 0
-        self.snapshots_published = 0
+        self._digest_requests: deque[Future] = deque()  # guarded_by: _mu|_work
+        self.heartbeats_published = 0  # guarded_by: _mu|_work
+        self.snapshots_published = 0  # guarded_by: _mu|_work
         self._self_heal_stop = threading.Event()
         self._self_heal_thread: Optional[threading.Thread] = None
         if self.config.transfer_endpoint:
@@ -913,7 +913,8 @@ class PodServer:
             # Wedged clients / runaway generations past the budget: abort
             # them (pages released, futures resolve with partial output)
             # rather than holding the rolling restart hostage.
-            self.drain_forced_requests += leftover
+            with self._mu:
+                self.drain_forced_requests += leftover
             self.metrics.observe_drain("forced", leftover)
             log.error(
                 "drain timeout; aborting inflight requests",
@@ -943,7 +944,8 @@ class PodServer:
 
     @property
     def is_draining(self) -> bool:
-        return self._draining
+        with self._mu:
+            return self._draining
 
     def shutdown(self) -> None:
         self._self_heal_stop.set()
@@ -1291,6 +1293,10 @@ class PodServer:
     def _publish_heartbeat(self) -> None:
         if self._publisher is None:
             return
+        # Flag read under the lock; the (bounded-blocking) publish stays
+        # outside it so a retrying socket never convoys submit/drain.
+        with self._mu:
+            draining = self._draining
         try:
             self._publisher.publish(
                 [
@@ -1298,11 +1304,12 @@ class PodServer:
                         dropped_batches=getattr(
                             self._publisher, "dropped_batches", 0
                         ),
-                        draining=self._draining,
+                        draining=draining,
                     )
                 ]
             )
-            self.heartbeats_published += 1
+            with self._mu:
+                self.heartbeats_published += 1
         except Exception:
             log.exception("heartbeat publish failed")
 
@@ -1327,7 +1334,8 @@ class PodServer:
             try:
                 digest = f.result()
                 self._publisher.publish([IndexSnapshot(blocks_by_medium=digest)])
-                self.snapshots_published += 1
+                with self._mu:
+                    self.snapshots_published += 1
                 done.set_result(True)
             except Exception:
                 log.exception("index snapshot publish failed")
@@ -1618,7 +1626,8 @@ class PodServer:
                 else 0
             )
         except (TransferError, RuntimeError, FuturesTimeout) as e:
-            self.transfer_pull_failures += 1
+            with self._mu:  # concurrent HTTP pulls race this counter
+                self.transfer_pull_failures += 1
             log.warning(
                 "KV pull failed; falling back to cold prefill",
                 source=source_endpoint,
@@ -1627,7 +1636,8 @@ class PodServer:
             span.set_attr("error", repr(e))
             return done(0, "failed")
         if imported:
-            self.transfer_pulls += 1
+            with self._mu:  # concurrent HTTP pulls race this counter
+                self.transfer_pulls += 1
         return done(imported, "ok" if imported else "empty")
 
     # -- request path -------------------------------------------------------
@@ -1645,7 +1655,7 @@ class PodServer:
             est = queued_tokens / self.engine._prefill_rate
         return float(min(max(est if est is not None else 1.0, 1.0), 60.0))
 
-    def _check_admission(self, n_tokens: int) -> None:
+    def _check_admission(self, n_tokens: int) -> None:  # kvlint: holds=_work
         """Admission control (caller holds ``_mu``): reject fast — before
         the request touches the engine — when the configured queue-depth or
         queued-token cap would be exceeded. Both caps off (0) = legacy
@@ -1970,7 +1980,9 @@ class PodServer:
                 return web.json_response(
                     {"status": "failed", "error": self._failed}, status=503
                 )
-            if self._draining:
+            with self._mu:
+                draining = self._draining
+            if draining:
                 # k8s readiness must agree with admission: a draining pod
                 # takes no new traffic.
                 return web.json_response({"status": "draining"}, status=503)
@@ -1994,6 +2006,9 @@ class PodServer:
         async def stats(_request: web.Request) -> web.Response:
             bm = self.engine.block_manager
             with self._mu:
+                # One consistent cut of everything _mu guards (kvlint
+                # lock-discipline: counters outside the lock could pair a
+                # new value with stale queue depths in the same scrape).
                 staged = len(self._staging)
                 pending = self._pending
                 pending_tokens = self._pending_tokens
@@ -2006,6 +2021,19 @@ class PodServer:
                     client.breaker_skips
                     for client in self._transfer_clients.values()
                 )
+                pulls = self.transfer_pulls
+                pull_failures = self.transfer_pull_failures
+                heartbeats_published = self.heartbeats_published
+                snapshots_published = self.snapshots_published
+                rejected = self.admission_rejected
+                rejected_draining = self.admission_rejected_draining
+                draining = self._draining
+                drains_started = self.drains_started
+                drain_forced = self.drain_forced_requests
+                importing = len(self._pull_jobs)
+                async_pulls = self.async_pulls
+                async_fallbacks = self.async_pull_fallbacks
+                async_canceled = self.async_pull_canceled
             payload = {
                 "pod": self.config.pod_identifier,
                 "model": self.config.model_name,
@@ -2019,8 +2047,8 @@ class PodServer:
                 "transfer": {
                     **self.engine.transfer_stats,
                     "endpoint": self.config.transfer_endpoint,
-                    "pulls": self.transfer_pulls,
-                    "pull_failures": self.transfer_pull_failures,
+                    "pulls": pulls,
+                    "pull_failures": pull_failures,
                     "breaker_skips": breaker_skips,
                     "breakers": breakers,
                     "requests_served": (
@@ -2032,8 +2060,8 @@ class PodServer:
                 "self_heal": {
                     "heartbeat_interval_s": self.config.heartbeat_interval_s,
                     "resync_interval_s": self.config.resync_interval_s,
-                    "heartbeats_published": self.heartbeats_published,
-                    "snapshots_published": self.snapshots_published,
+                    "heartbeats_published": heartbeats_published,
+                    "snapshots_published": snapshots_published,
                     "event_batches_dropped": getattr(
                         self._publisher, "dropped_batches", 0
                     ),
@@ -2044,28 +2072,26 @@ class PodServer:
                     "default_deadline_s": self.config.default_deadline_s,
                     "pending_requests": pending,
                     "pending_prompt_tokens": pending_tokens,
-                    "rejected": self.admission_rejected,
-                    "rejected_draining": self.admission_rejected_draining,
+                    "rejected": rejected,
+                    "rejected_draining": rejected_draining,
                     **dict(self.engine.lifecycle_stats),
                 },
                 "drain": {
-                    "draining": self._draining,
+                    "draining": draining,
                     "drain_timeout_s": self.config.drain_timeout_s,
-                    "drains_started": self.drains_started,
-                    "forced_requests": self.drain_forced_requests,
+                    "drains_started": drains_started,
+                    "forced_requests": drain_forced,
                 },
             }
             if self.config.async_pull:
                 # Async-import block only when the knob is on: the
                 # knobs-off /stats payload stays bit-identical.
-                with self._mu:
-                    importing = len(self._pull_jobs)
                 payload["transfer"]["async_pull"] = {
                     "workers": self.config.pull_workers,
                     "importing": importing,
-                    "pulls": self.async_pulls,
-                    "fallbacks": self.async_pull_fallbacks,
-                    "canceled": self.async_pull_canceled,
+                    "pulls": async_pulls,
+                    "fallbacks": async_fallbacks,
+                    "canceled": async_canceled,
                 }
             if bm.config.host_pages > 0:
                 # Host tier + KV quant block only when the tier knob is on:

@@ -87,9 +87,9 @@ class TokenizationPool:
             self.config.hf_tokenizer
         )
         self._queue: "queue.Queue[Optional[_Task]]" = queue.Queue()
-        self._threads: list[threading.Thread] = []
-        self._running = False
         self._mu = threading.Lock()
+        self._threads: list[threading.Thread] = []  # guarded_by: _mu
+        self._running = False  # guarded_by: _mu
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:

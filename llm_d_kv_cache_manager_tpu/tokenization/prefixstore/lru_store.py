@@ -30,8 +30,8 @@ class LRUTokenStore(Indexer):
         self.config = config or Config()
         if self.config.block_size < 1:
             raise ValueError("block_size must be >= 1")
-        self._stores: dict[str, LRUCache[int, list[int]]] = {}
         self._mu = threading.Lock()
+        self._stores: dict[str, LRUCache[int, list[int]]] = {}  # guarded_by: _mu
 
     def _model_cache(self, model_name: str, create: bool) -> Optional[LRUCache]:
         with self._mu:

@@ -58,12 +58,12 @@ class ContainedTokenStore(Indexer):
 
     def __init__(self, config: Optional[Config] = None):
         self.config = config or Config()
-        self._tries: OrderedDict[str, _Node] = OrderedDict()
-        self._counts: dict[str, int] = {}  # nodes per model, incl. root
-        self._gen = 0
         self._mu = threading.RLock()
+        self._tries: OrderedDict[str, _Node] = OrderedDict()  # guarded_by: _mu
+        self._counts: dict[str, int] = {}  # nodes per model  # guarded_by: _mu
+        self._gen = 0  # guarded_by: _mu
 
-    def _trie(self, model_name: str, create: bool) -> Optional[_Node]:
+    def _trie(self, model_name: str, create: bool) -> Optional[_Node]:  # kvlint: holds=_mu
         trie = self._tries.get(model_name)
         if trie is None and create:
             trie = _Node()
@@ -81,7 +81,7 @@ class ContainedTokenStore(Indexer):
         with self._mu:
             return self._counts.get(model_name, 0)
 
-    def _enforce_budget(self, model_name: str, root: _Node) -> None:
+    def _enforce_budget(self, model_name: str, root: _Node) -> None:  # kvlint: holds=_mu
         """Cap the model trie at ``config.trie_max_nodes`` nodes.
 
         First prune subtrees whose generation is stale: the lookup rule

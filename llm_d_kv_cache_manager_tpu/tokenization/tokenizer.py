@@ -69,8 +69,8 @@ class CachedHFTokenizer(Tokenizer):
     def __init__(self, config: Optional[HFTokenizerConfig] = None):
         self.config = config or HFTokenizerConfig()
         self._cache: LRUCache[str, object] = LRUCache(self.config.tokenizers_cache_size)
-        self._load_locks: dict[str, threading.Lock] = {}
         self._mu = threading.Lock()
+        self._load_locks: dict[str, threading.Lock] = {}  # guarded_by: _mu
 
     def _load(self, model_name: str):
         from tokenizers import Tokenizer as HFTokenizer  # Rust core, lazy import

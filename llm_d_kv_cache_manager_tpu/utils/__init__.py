@@ -1,3 +1,3 @@
-from .logging import get_logger, log_context, DEBUG, TRACE
+from .logging import DEBUG, TRACE, RateLimitedWarn, get_logger, log_context
 
-__all__ = ["get_logger", "log_context", "DEBUG", "TRACE"]
+__all__ = ["get_logger", "log_context", "RateLimitedWarn", "DEBUG", "TRACE"]

@@ -1,0 +1,324 @@
+"""locktrace: runtime lock-order and guarded-attribute race detection.
+
+The static half (``tools/kvlint``, rule ``lock-discipline``) checks what
+is lexically provable; this module catches what only execution reveals:
+
+- **lock-order cycles.** Every instrumented acquire records the set of
+  locks the acquiring thread already holds and adds edges
+  ``held -> acquired`` to a global lock-order graph. A cycle in that
+  graph is a potential deadlock (thread 1 takes A then B, thread 2 takes
+  B then A — each waits on the other), flagged the FIRST time the
+  inverted order is exercised, long before the interleaving that would
+  actually deadlock. This is the classic happens-before order check that
+  gives Go's ``-race`` and pthread lockdep their payoff.
+- **unguarded cross-thread mutation.** ``guard_attrs(obj, lock, *attrs)``
+  rebinds the object's class so every write (and optionally read) of a
+  guarded attribute asserts the lock is held by the writing thread —
+  the runtime twin of the ``# guarded_by:`` annotation.
+
+Opt-in and test-only by design: ``activate()`` monkeypatches
+``threading.Lock``/``threading.RLock`` factories so EVERY lock created
+afterwards is traced; tests enable it via the ``LOCKTRACE=1`` env var
+(see ``tests/conftest.py``, wired into the concurrency hammer and chaos
+suites). Zero cost when not activated — production code paths never
+import anything from here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+__all__ = [
+    "activate",
+    "deactivate",
+    "enabled",
+    "reset",
+    "violations",
+    "assert_clean",
+    "TracingLock",
+    "guard_attrs",
+    "Violation",
+]
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def enabled() -> bool:
+    """True when the harness is requested for this process (``LOCKTRACE=1``)."""
+    return os.environ.get("LOCKTRACE", "").strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass(frozen=True)
+class Violation:
+    kind: str  # "lock-order-cycle" | "unguarded-mutation"
+    message: str
+    stack: str
+
+    def render(self) -> str:
+        return f"[{self.kind}] {self.message}\n{self.stack}"
+
+
+@dataclass
+class _Graph:
+    """Global lock-order graph + held-lock bookkeeping, single mutex."""
+
+    mu: threading.Lock = field(default_factory=_REAL_LOCK)
+    #: lock name -> set of lock names acquired while it was held
+    edges: dict[str, set[str]] = field(default_factory=dict)
+    #: (held, acquired) -> acquisition stack that created the edge
+    edge_sites: dict[tuple[str, str], str] = field(default_factory=dict)
+    violations: list[Violation] = field(default_factory=list)
+    #: edges already reported, so a hot inverted pair fires once
+    reported: set[tuple[str, str]] = field(default_factory=set)
+
+
+_GRAPH = _Graph()
+#: per-thread list of (lock name, lock instance id) in acquisition order.
+#: Order-graph edges use the NAME (allocation-site "lock class", lockdep
+#: granularity); ownership checks (guard_attrs, Condition._is_owned) use
+#: the instance id so two same-site locks never alias each other's holds.
+_HELD = threading.local()
+
+
+def _held_stack() -> list[tuple[str, int]]:
+    stack = getattr(_HELD, "entries", None)
+    if stack is None:
+        stack = []
+        _HELD.entries = stack
+    return stack
+
+
+def _find_cycle(start: str, target: str) -> Optional[list[str]]:
+    """Path target ->* start in the edge graph (so start -> target closes
+    a cycle). Iterative DFS; the graph is tiny (locks in one process)."""
+    path = [target]
+    seen = {target}
+    stack: list[tuple[str, Iterable[str]]] = [
+        (target, iter(_GRAPH.edges.get(target, ())))
+    ]
+    while stack:
+        node, it = stack[-1]
+        found = None
+        for nxt in it:
+            if nxt == start:
+                return path + [start]
+            if nxt not in seen:
+                found = nxt
+                break
+        if found is None:
+            stack.pop()
+            path.pop()
+            continue
+        seen.add(found)
+        path.append(found)
+        stack.append((found, iter(_GRAPH.edges.get(found, ()))))
+    return None
+
+
+def _record_acquire(name: str, lock_id: int, reentrant: bool) -> None:
+    held = _held_stack()
+    if not held:
+        held.append((name, lock_id))
+        return
+    stack_txt: Optional[str] = None  # formatted lazily: hot path
+    with _GRAPH.mu:
+        for h, _hid in held:
+            if h == name and reentrant:
+                # Same lock class re-acquired by an RLock: legal
+                # re-entrance. (Lock identity is the allocation site, so
+                # cross-instance nesting within one class is conflated
+                # with it — the lockdep granularity tradeoff.) A
+                # NON-reentrant Lock nesting its own class is kept: same
+                # instance would self-deadlock, two instances are an
+                # unordered pair — both worth a violation.
+                continue
+            edge = (h, name)
+            _GRAPH.edges.setdefault(h, set()).add(name)
+            if edge not in _GRAPH.edge_sites:
+                if stack_txt is None:
+                    stack_txt = "".join(traceback.format_stack(limit=12)[:-2])
+                _GRAPH.edge_sites[edge] = stack_txt
+            cycle = _find_cycle(h, name)
+            if cycle is not None and edge not in _GRAPH.reported:
+                if stack_txt is None:
+                    stack_txt = "".join(traceback.format_stack(limit=12)[:-2])
+                _GRAPH.reported.add(edge)
+                back_site = _GRAPH.edge_sites.get(
+                    (cycle[0], cycle[1]), "(edge site unknown)"
+                )
+                _GRAPH.violations.append(
+                    Violation(
+                        kind="lock-order-cycle",
+                        message=(
+                            "lock acquisition order inverted: "
+                            + " -> ".join(cycle + [cycle[0]])
+                            + f" (this thread holds {h!r} and is taking "
+                            f"{name!r}; another code path takes them in the "
+                            "opposite order — potential ABBA deadlock)"
+                        ),
+                        stack=(
+                            "forward acquisition:\n"
+                            + stack_txt
+                            + "conflicting prior edge recorded at:\n"
+                            + back_site
+                        ),
+                    )
+                )
+    held.append((name, lock_id))
+
+
+def _record_release(name: str, lock_id: int) -> None:
+    held = _held_stack()
+    # release order need not be LIFO; drop the most recent matching entry
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == (name, lock_id):
+            del held[i]
+            return
+
+
+class TracingLock:
+    """Drop-in ``threading.Lock``/``RLock`` wrapper feeding the order graph.
+
+    Named by allocation site (``file:line``) so violations point at the
+    lock's birthplace, the stable identity a human can act on.
+    """
+
+    def __init__(self, reentrant: bool = False, name: Optional[str] = None):
+        self._lock = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self._reentrant = reentrant
+        if name is None:
+            # allocation site: nearest frame outside this module
+            for fr in reversed(traceback.extract_stack(limit=8)[:-1]):
+                if "locktrace" not in fr.filename:
+                    name = f"{os.path.basename(fr.filename)}:{fr.lineno}"
+                    break
+        self.name = name or "lock:?"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _record_acquire(self.name, id(self), self._reentrant)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        _record_release(self.name, id(self))
+
+    def __enter__(self) -> "TracingLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked() if hasattr(self._lock, "locked") else False
+
+    def held_by_current_thread(self) -> bool:
+        """THIS instance (not merely its lock class) held by the caller."""
+        return (self.name, id(self)) in _held_stack()
+
+    # condition variables etc. reach for the raw lock's protocol
+    def _is_owned(self):  # pragma: no cover - RLock/Condition internals
+        if hasattr(self._lock, "_is_owned"):
+            return self._lock._is_owned()
+        return self.held_by_current_thread()
+
+    def __getattr__(self, name: str):
+        # CPython internals (``_at_fork_reinit``, ``_release_save``,
+        # ``_acquire_restore``) and any future lock protocol surface
+        # delegate to the real lock — only attributes not defined above
+        # reach here.
+        return getattr(self._lock, name)
+
+
+def activate() -> None:
+    """Route ``threading.Lock``/``RLock`` creation through TracingLock.
+
+    Locks created BEFORE activation stay raw (interpreter internals,
+    import-time singletons) — the fleet under test creates its locks at
+    object construction, inside the activated window.
+    """
+    threading.Lock = lambda: TracingLock(reentrant=False)  # type: ignore[misc]
+    threading.RLock = lambda: TracingLock(reentrant=True)  # type: ignore[misc]
+
+
+def deactivate() -> None:
+    threading.Lock = _REAL_LOCK  # type: ignore[misc]
+    threading.RLock = _REAL_RLOCK  # type: ignore[misc]
+
+
+def reset() -> None:
+    """Clear the order graph and violations (between tests)."""
+    with _GRAPH.mu:
+        _GRAPH.edges.clear()
+        _GRAPH.edge_sites.clear()
+        _GRAPH.violations.clear()
+        _GRAPH.reported.clear()
+
+
+def violations() -> list[Violation]:
+    with _GRAPH.mu:
+        return list(_GRAPH.violations)
+
+
+def assert_clean() -> None:
+    """Raise AssertionError listing every recorded violation (test gate)."""
+    vs = violations()
+    if vs:
+        raise AssertionError(
+            f"locktrace recorded {len(vs)} violation(s):\n\n"
+            + "\n\n".join(v.render() for v in vs)
+        )
+
+
+def _record_unguarded(obj: object, attr: str, lock: object) -> None:
+    with _GRAPH.mu:
+        _GRAPH.violations.append(
+            Violation(
+                kind="unguarded-mutation",
+                message=(
+                    f"{type(obj).__name__}.{attr} written by "
+                    f"{threading.current_thread().name} without holding its "
+                    f"guarding lock ({getattr(lock, 'name', lock)!r}) — the "
+                    "guarded_by contract is violated at runtime"
+                ),
+                stack="".join(traceback.format_stack(limit=10)[:-2]),
+            )
+        )
+
+
+def guard_attrs(obj: object, lock: object, *attrs: str) -> object:
+    """Runtime twin of ``# guarded_by:``: every subsequent write to the
+    named attributes must happen with ``lock`` held by the writing thread.
+
+    Implemented by grafting a one-off subclass with a checking
+    ``__setattr__`` onto the instance — no cost to other instances, no
+    cost at all when locktrace is off (callers gate on ``enabled()``).
+    ``lock`` may be a ``TracingLock`` (precise per-thread ownership) or a
+    raw lock (falls back to ``locked()``, a weaker check).
+    """
+    guarded = frozenset(attrs)
+    cls = type(obj)
+
+    def _holds() -> bool:
+        if isinstance(lock, TracingLock):
+            return lock.held_by_current_thread()
+        locked = getattr(lock, "locked", None)
+        return bool(locked()) if callable(locked) else True
+
+    def __setattr__(self, name, value):  # noqa: N807
+        if name in guarded and not _holds():
+            _record_unguarded(self, name, lock)
+        super(traced_cls, self).__setattr__(name, value)
+
+    traced_cls = type(
+        f"LockTraced{cls.__name__}", (cls,), {"__setattr__": __setattr__}
+    )
+    obj.__class__ = traced_cls
+    return obj

@@ -12,6 +12,8 @@ import contextlib
 import contextvars
 import logging
 import os
+import threading
+import time
 
 #: request-scoped structured-log context: {"request_id": ..., "trace_id":
 #: ...} injected into every record emitted inside a ``log_context`` block,
@@ -99,3 +101,39 @@ def get_logger(name: str) -> _KVLogger:
     if not name.startswith(_PKG_LOGGER):
         name = f"{_PKG_LOGGER}.{name}"
     return _KVLogger(logging.getLogger(name), {})
+
+
+class RateLimitedWarn:
+    """At-most-once-per-interval WARN per key, with a suppressed count.
+
+    Background threads (event workers, heartbeat/self-heal loops, span
+    exporters) hit the same fault thousands of times a second when a peer
+    misbehaves; an unconditional ``log.exception`` per event is itself an
+    outage. This emits the first occurrence immediately, then at most one
+    line per ``interval_s`` per key carrying how many were swallowed in
+    between — faults stay visible without the log volume scaling with the
+    event rate.
+
+    Thread-safe; uses ``time.monotonic`` (rate math must not step under
+    NTP slew).
+    """
+
+    def __init__(self, log: _KVLogger, interval_s: float = 5.0):
+        self._log = log
+        self._interval_s = interval_s
+        self._lock = threading.Lock()
+        self._last_emit: dict[str, float] = {}  # guarded_by: _lock
+        self._suppressed: dict[str, int] = {}  # guarded_by: _lock
+
+    def warning(self, key: str, msg: str, *, exc_info: bool = False, **kv) -> None:
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_emit.get(key)
+            if last is not None and now - last < self._interval_s:
+                self._suppressed[key] = self._suppressed.get(key, 0) + 1
+                return
+            suppressed = self._suppressed.pop(key, 0)
+            self._last_emit[key] = now
+        if suppressed:
+            kv["suppressed_repeats"] = suppressed
+        self._log.warning(msg, exc_info=exc_info, **kv)

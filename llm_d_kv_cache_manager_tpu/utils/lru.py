@@ -18,8 +18,8 @@ class LRUCache(Generic[K, V]):
         if capacity < 1:
             raise ValueError(f"LRU capacity must be >= 1, got {capacity}")
         self.capacity = capacity
-        self._data: OrderedDict[K, V] = OrderedDict()
         self._lock = threading.RLock()
+        self._data: OrderedDict[K, V] = OrderedDict()  # guarded_by: _lock
 
     def get(self, key: K) -> Optional[V]:
         with self._lock:

@@ -52,6 +52,14 @@ def free_tcp_port() -> int:
 
 
 def pytest_configure(config):
+    # Lock-order/race harness: LOCKTRACE=1 routes every lock created from
+    # here on through utils.locktrace's TracingLock, so the concurrency
+    # hammer and chaos suites run under cycle + guarded-attribute checking
+    # (CI runs them that way; plain local runs are untouched).
+    from llm_d_kv_cache_manager_tpu.utils import locktrace
+
+    if locktrace.enabled():
+        locktrace.activate()
     config.addinivalue_line(
         "markers",
         "network: needs a real HF tokenizer (network or populated HF cache); "
@@ -101,6 +109,32 @@ _SLOW_CLASSES = {
 #: eating the whole tier-1 budget. Generous: the slowest legitimate tests
 #: (fuzz matrices, multi-config sweeps) finish well under it.
 _PER_TEST_TIMEOUT_S = 300
+
+
+def pytest_unconfigure(config):
+    from llm_d_kv_cache_manager_tpu.utils import locktrace
+
+    if locktrace.enabled():
+        locktrace.deactivate()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _locktrace_gate():
+    """Fail any test on lock-order cycles / unguarded mutations recorded
+    while it ran (LOCKTRACE=1 only; zero-cost no-op otherwise). A test that
+    intentionally seeds a violation consumes it and calls ``reset()``
+    before returning, so it passes this gate clean."""
+    yield
+    from llm_d_kv_cache_manager_tpu.utils import locktrace
+
+    if locktrace.enabled():
+        try:
+            locktrace.assert_clean()
+        finally:
+            locktrace.reset()
 
 
 def pytest_collection_modifyitems(config, items):

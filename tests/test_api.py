@@ -8,7 +8,6 @@ e2e does the same with a mock wrapper, ``e2e_test.go:227-358``).
 import asyncio
 import socket
 
-import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import PodEntry

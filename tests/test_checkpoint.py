@@ -148,8 +148,6 @@ class TestQuantizedCheckpoint:
         assert serve(params) == serve(restored)
 
     def test_quantized_sharded_restore_onto_mesh(self, tmp_path):
-        from llm_d_kv_cache_manager_tpu.models.quant import QuantizedTensor
-
         params = init_params(jax.random.PRNGKey(6), TINY_LLAMA, quantize="int8")
         save_params(str(tmp_path / "q"), params)
         mesh = make_mesh(MeshConfig(dp=2, tp=2))

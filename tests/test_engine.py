@@ -10,10 +10,8 @@ The load-bearing invariants:
 
 import numpy as np
 import pytest
-import jax
-import jax.numpy as jnp
 
-from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, init_params
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
 from llm_d_kv_cache_manager_tpu.server import (
     BlockManager,
     BlockManagerConfig,

@@ -2,11 +2,9 @@
 poison pills, and the end-to-end ZMQ offline-demo flow (reference §3.5)."""
 
 import struct
-import threading
 import time
 
 import msgpack
-import pytest
 
 from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
     DeviceTier,

@@ -738,7 +738,7 @@ class TestRoutedDispatch:
         n, k, f = 64, cfg.n_experts_per_tok, cfg.moe_inter
         x = jnp.zeros((1, n, cfg.hidden_size), jnp.float32)
 
-        jaxpr = jax.make_jaxpr(lambda l, v: _moe_mlp(l, cfg, v))(layer, x)
+        jaxpr = jax.make_jaxpr(lambda p, v: _moe_mlp(p, cfg, v))(layer, x)
         prims = {e.primitive.name for e in jaxpr.eqns}
         assert "ragged_dot" in prims or "ragged_dot_general" in prims, prims
         dense_inter = cfg.n_experts * n * f
@@ -761,7 +761,7 @@ class TestRoutedDispatch:
         )
 
         dense_jaxpr = jax.make_jaxpr(
-            lambda l, v: _moe_mlp(l, dataclasses.replace(cfg, moe_dispatch="dense"), v)
+            lambda p, v: _moe_mlp(p, dataclasses.replace(cfg, moe_dispatch="dense"), v)
         )(layer, x)
         dense_biggest = max(
             int(np.prod(v.aval.shape))
@@ -787,7 +787,7 @@ class TestRoutedDispatch:
         x = jnp.zeros((1, 64, cfg.hidden_size), jnp.float32)
 
         def flops(c):
-            fn = jax.jit(lambda l, v: _moe_mlp(l, c, v))
+            fn = jax.jit(lambda p, v: _moe_mlp(p, c, v))
             an = fn.lower(layer, x).compile().cost_analysis()
             an = an[0] if isinstance(an, list) else an
             return an["flops"]

@@ -15,7 +15,6 @@ from llm_d_kv_cache_manager_tpu.parallel import (
     MeshConfig,
     batch_sharding,
     make_mesh,
-    make_train_state,
     param_shardings,
     shard_params,
     train_step,
@@ -207,7 +206,7 @@ class TestMoEExpertParallel:
         layer = params["layers"][0]
         x = jnp.zeros((2, 8, cfg.hidden_size), jnp.float32)
 
-        jaxpr = jax.make_jaxpr(lambda l, v: _moe_mlp(l, cfg, v, mesh=mesh))(layer, x)
+        jaxpr = jax.make_jaxpr(lambda p, v: _moe_mlp(p, cfg, v, mesh=mesh))(layer, x)
         sm = [e for e in jaxpr.eqns if e.primitive.name == "shard_map"]
         assert sm, {e.primitive.name for e in jaxpr.eqns}
         inner = sm[0].params["jaxpr"]
@@ -236,7 +235,7 @@ class TestMoEExpertParallel:
         layer = params["layers"][0]
         x = jnp.zeros((2, 8, TINY_MOE.hidden_size), jnp.float32)
         jaxpr = jax.make_jaxpr(
-            lambda l, v: _moe_mlp(l, TINY_MOE, v, mesh=mesh)
+            lambda p, v: _moe_mlp(p, TINY_MOE, v, mesh=mesh)
         )(layer, x)
         prims = {e.primitive.name for e in jaxpr.eqns}
         assert "ragged_dot" not in prims and "ragged_dot_general" not in prims

@@ -7,7 +7,6 @@ the rest. The tracker is also bench.py's `estimated` comparator, so its
 LRU/TTL semantics are product code, not bench-only logic.
 """
 
-import pytest
 
 from llm_d_kv_cache_manager_tpu.kvcache import (
     BlendedRouter,

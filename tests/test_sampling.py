@@ -7,7 +7,6 @@ distribution, so they are pinned as pure-function tests.
 """
 
 import numpy as np
-import pytest
 import jax
 import jax.numpy as jnp
 

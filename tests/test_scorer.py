@@ -1,6 +1,5 @@
 """LongestPrefixScorer unit tests (reference ``kvblock_scorer_test.go:35-60``)."""
 
-import pytest
 
 from llm_d_kv_cache_manager_tpu.kvcache import (
     KVBlockScorerConfig,

@@ -1,0 +1,43 @@
+"""kvlint: repo-invariant static analysis for the threaded serving fleet.
+
+Seven PRs of convention — every knob off by default, append-only msgpack
+wire formats, pinned Prometheus exposition names, lock-guarded shared
+state, monotonic clocks in rate math — enforced so far only by reviewer
+discipline. kvlint turns each convention into an AST checker that fails
+CI, the same payoff Go's ``-race`` and vLLM's lint gates buy their
+serving stacks: invariants stay invariant as the thread count grows.
+
+Rules (each suppressible per line with ``# kvlint: disable=<rule>``):
+
+- ``knob-default``      every ``*Config`` field / env knob must default to
+                        off/0/None unless declared in ``knob_allowlist.txt``
+- ``wire-append-only``  wire frames (transfer ``protocol.py``, kvevents
+                        payload builders) may only grow optional trailing
+                        fields; positional inserts/reorders are flagged
+                        against ``wire_manifest.json``
+- ``metric-pin``        every Prometheus name constructed in the metric
+                        modules must appear in the ``docs/observability.md``
+                        catalog, and vice versa
+- ``lock-discipline``   attributes annotated ``# guarded_by: _lock`` may
+                        only be touched under ``with self._lock``; blocking
+                        calls (``time.sleep``, ZMQ recv/send, jax dispatch)
+                        are flagged while a lock is held
+- ``monotonic-time``    rate/deadline/backoff arithmetic must use
+                        ``time.monotonic()``; wall clock only where a
+                        timestamp crosses the wire (suppress + justify)
+
+Run: ``python -m tools.kvlint llm_d_kv_cache_manager_tpu/``
+
+The runtime companion is ``llm_d_kv_cache_manager_tpu/utils/locktrace.py``
+(lock-order cycle + guarded-attribute race detection under ``LOCKTRACE=1``).
+"""
+
+from __future__ import annotations
+
+from tools.kvlint.core import (  # noqa: F401
+    Finding,
+    ModuleUnit,
+    RepoContext,
+    all_rules,
+    lint_paths,
+)

@@ -1,0 +1,56 @@
+"""CLI: ``python -m tools.kvlint <paths...>``.
+
+Exit status 0 = clean, 1 = findings, 2 = usage error. ``--rule`` limits
+the run to one rule (repeatable); ``--list-rules`` prints the registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.kvlint.core import all_rules, lint_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.kvlint",
+        description="repo-invariant static analysis (see tools/kvlint/__init__.py)",
+    )
+    parser.add_argument("targets", nargs="*", help="files or directories to lint")
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE",
+        help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print known rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, mod in all_rules().items():
+            doc = (mod.__doc__ or "").strip().splitlines()
+            print(f"{rule}: {doc[0] if doc else ''}")
+        return 0
+    if not args.targets:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    findings = lint_paths(args.targets, rules=args.rules)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(
+            f"kvlint: {len(findings)} finding(s). Fix, or suppress a justified "
+            "exception with '# kvlint: disable=<rule>' plus a why-comment.",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+"""kvlint checker registry — one module per rule (see ``core.all_rules``)."""

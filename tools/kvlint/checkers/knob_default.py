@@ -1,0 +1,180 @@
+"""knob-default: every config field / env knob must default to off.
+
+The fleet's compatibility story is "no knobs set = bit-identical legacy
+behavior". That only holds if every knob introduced anywhere defaults to
+off/0/None/False. Knobs that legitimately need a non-off default (sizing
+parameters like ``decode_batch_size``, pre-existing on-by-default
+surfaces like ``publish_events``) are declared in
+``tools/kvlint/knob_allowlist.txt`` — adding a line there is a reviewed,
+diff-visible act.
+
+Checked surfaces:
+
+- class-level defaults of any ``*Config`` dataclass
+- ``os.environ.get("NAME", default)`` / ``os.getenv`` / ``env.get`` with a
+  literal default (non-literal defaults, e.g. ``cfg.x``, defer to the
+  dataclass default already checked)
+- ``_env_bool("NAME", default)``-style boolean-knob helpers
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from tools.kvlint.core import Finding, ModuleUnit, RepoContext
+
+RULE = "knob-default"
+
+#: literal defaults that read as "off"/zero/unset
+_OFF_VALUES = {None, False, 0, 0.0, "", "off", "auto", "0", "false", "no"}
+
+#: env-var shape: SCREAMING_SNAKE — keeps plain ``dict.get`` out of scope
+_ENV_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+_ENV_RECEIVERS = {"env", "environ"}
+_FALSY_BOOL_STRINGS = {"", "0", "false", "no", "off"}
+
+
+def _load_allowlist(ctx: RepoContext) -> set[str]:
+    cached = ctx.parsed_cache.get("knob_allowlist")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    text = ctx.read_repo_file("tools/kvlint/knob_allowlist.txt") or ""
+    entries: set[str] = set()
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if line:
+            entries.add(line)
+    ctx.parsed_cache["knob_allowlist"] = entries
+    return entries
+
+
+def _is_off(value: object) -> bool:
+    if isinstance(value, str):
+        return value.lower() in _OFF_VALUES
+    if isinstance(value, bool):
+        return value is False
+    return value in (None, 0, 0.0)
+
+
+def _const(node: ast.AST) -> Optional[ast.Constant]:
+    return node if isinstance(node, ast.Constant) else None
+
+
+def _field_default(node: ast.expr) -> Optional[ast.Constant]:
+    """``field(default=<literal>)`` → that literal; None otherwise.
+    ``field(default_factory=...)`` builds composites, not toggles — skip."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "field"
+    ):
+        for kw in node.keywords:
+            if kw.arg == "default":
+                return _const(kw.value)
+    return None
+
+
+def _env_get_call(node: ast.Call) -> Optional[str]:
+    """Env-knob read? Returns the env var name, else None."""
+    fn = node.func
+    name_arg = node.args[0] if node.args else None
+    c = _const(name_arg) if name_arg is not None else None
+    if c is None or not isinstance(c.value, str) or not _ENV_NAME_RE.match(c.value):
+        return None
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "getenv":
+            return c.value  # os.getenv("NAME", ...)
+        if fn.attr == "get":
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id in _ENV_RECEIVERS:
+                return c.value  # env.get / environ.get
+            if (
+                isinstance(recv, ast.Attribute)
+                and recv.attr == "environ"
+            ):
+                return c.value  # os.environ.get
+    return None
+
+
+def check(unit: ModuleUnit, ctx: RepoContext) -> list[Finding]:
+    allow = _load_allowlist(ctx)
+    findings: list[Finding] = []
+
+    def flag(line: int, key: str, shown_default: str) -> None:
+        if key in allow:
+            return
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=unit.rel,
+                line=line,
+                message=(
+                    f"knob '{key}' defaults on ({shown_default}); knobs must "
+                    "default to off/0/None so no-knobs runs stay bit-identical "
+                    "legacy — or declare it in tools/kvlint/knob_allowlist.txt"
+                ),
+            )
+        )
+
+    for node in ast.walk(unit.tree):
+        # --- *Config dataclass fields -------------------------------------
+        if isinstance(node, ast.ClassDef) and node.name.endswith("Config"):
+            for stmt in node.body:
+                target: Optional[str] = None
+                default: Optional[ast.expr] = None
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    target, default = stmt.target.id, stmt.value
+                elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+                    isinstance(stmt.targets[0], ast.Name)
+                ):
+                    target, default = stmt.targets[0].id, stmt.value
+                if target is None or default is None:
+                    continue
+                c = _const(default) or _field_default(default)
+                if c is None:
+                    continue  # default_factory / computed: not a toggle
+                if not _is_off(c.value):
+                    flag(stmt.lineno, f"{node.name}.{target}", repr(c.value))
+
+        # --- env reads -----------------------------------------------------
+        elif isinstance(node, ast.Call):
+            env_name = _env_get_call(node)
+            if env_name is not None and len(node.args) > 1:
+                c = _const(node.args[1])
+                if c is not None and not _is_off(c.value):
+                    flag(node.lineno, f"env:{env_name}", repr(c.value))
+                continue
+            # boolean-knob helpers: _env_bool("NAME", "1") means on-by-default
+            fn = node.func
+            helper = (
+                fn.id
+                if isinstance(fn, ast.Name)
+                else fn.attr
+                if isinstance(fn, ast.Attribute)
+                else ""
+            )
+            if ("env_bool" in helper or "env_flag" in helper) and len(node.args) > 1:
+                name_c = _const(node.args[0])
+                dflt_c = _const(node.args[1])
+                if (
+                    name_c is not None
+                    and isinstance(name_c.value, str)
+                    and _ENV_NAME_RE.match(name_c.value)
+                    and dflt_c is not None
+                ):
+                    v = dflt_c.value
+                    on = (
+                        v is True
+                        or (
+                            isinstance(v, str)
+                            and v.strip().lower() not in _FALSY_BOOL_STRINGS
+                        )
+                    )
+                    if on:
+                        flag(node.lineno, f"env:{name_c.value}", repr(v))
+    return findings

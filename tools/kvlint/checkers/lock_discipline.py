@@ -1,0 +1,222 @@
+"""lock-discipline: guarded state stays under its lock; locks stay quick.
+
+The convention (documented in ``docs/development.md``): shared mutable
+attributes are annotated at their initialising assignment —
+
+    self._views = {}  # guarded_by: _lock
+
+Two checks follow:
+
+1. every other ``self.<attr>`` touch of a guarded attribute must sit
+   lexically inside ``with self.<lock>`` for the DECLARED lock.
+   ``__init__`` is exempt (the object is not shared yet); a method whose
+   ``def`` line carries ``# kvlint: holds=<lock>`` documents a
+   caller-holds-the-lock contract and is treated as locked.
+2. while any ``self.*lock*`` is held, calls that can block or stall the
+   fleet — ``time.sleep``, ZMQ/socket ``recv``/``send_multipart``/
+   ``connect``, and ``jax``/``jnp`` dispatch — are flagged: a sleep under
+   a lock is a convoy, a device dispatch under a lock serialises the
+   engine against every other thread.
+
+The runtime companion (``utils/locktrace.py``) catches what static
+lexing cannot: cross-thread acquisition-order cycles and unguarded
+mutation observed live under ``LOCKTRACE=1``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional, Union
+
+from tools.kvlint.core import Finding, ModuleUnit, RepoContext
+
+RULE = "lock-discipline"
+
+_GUARDED_RE = re.compile(
+    r"self\.(\w+)\s*(?::[^=]*)?=.*#\s*guarded_by:\s*([\w|]+)"
+)
+_HOLDS_RE = re.compile(r"#\s*kvlint:\s*holds=(\w+)")
+
+#: attribute-call names that block on I/O or a peer
+_BLOCKING_ATTR_CALLS = {
+    "sleep",
+    "recv",
+    "recv_multipart",
+    "send_multipart",
+    "accept",
+    "connect",
+}
+#: module roots whose calls dispatch device work
+_DISPATCH_ROOTS = {"jax", "jnp"}
+
+_AnyFunc = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+def _is_lock_name(attr: str) -> bool:
+    """Lock-ish attribute names in this tree: ``_lock``, ``mu``/``_mu``
+    (the Go-parity modules), ``mutex``."""
+    low = attr.lower()
+    return (
+        "lock" in low
+        or "mutex" in low
+        or low == "mu"
+        or low.endswith("_mu")
+    )
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _guarded_attrs(unit: ModuleUnit, cls: ast.ClassDef) -> dict[str, frozenset[str]]:
+    """attr name -> acceptable lock attrs, from ``# guarded_by:`` comments
+    inside the class's line span. ``guarded_by: _mu|_work`` declares
+    aliases — e.g. a Condition built ON the mutex, either entry counts."""
+    end = cls.end_lineno or cls.lineno
+    out: dict[str, frozenset[str]] = {}
+    for ln in range(cls.lineno, end + 1):
+        m = _GUARDED_RE.search(unit.line_text(ln))
+        if m:
+            out[m.group(1)] = frozenset(m.group(2).split("|"))
+    return out
+
+
+def _held_at_def(unit: ModuleUnit, fn: _AnyFunc) -> set[str]:
+    m = _HOLDS_RE.search(unit.line_text(fn.lineno))
+    return {m.group(1)} if m else set()
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    def __init__(
+        self,
+        unit: ModuleUnit,
+        guarded: dict[str, frozenset[str]],
+        held: set[str],
+        lock_names: frozenset[str] = frozenset(),
+    ) -> None:
+        self.unit = unit
+        self.guarded = guarded
+        self.held = held
+        #: names declared as guards (incl. aliases like a Condition) even
+        #: when the attribute name itself is not lock-ish
+        self.lock_names = lock_names
+        self.findings: list[Finding] = []
+
+    # -- lock tracking ----------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        held_before = set(self.held)
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and (
+                _is_lock_name(attr) or attr in self.lock_names
+            ):
+                self.held.add(attr)
+        for stmt in node.body:
+            self.visit(stmt)
+        # Restore (not subtract): a nested ``with`` on an already-held lock
+        # (RLock re-entrance, or inside a ``holds=`` method) must not clear
+        # the outer hold for the code after the block.
+        self.held = held_before
+        # items themselves (e.g. ``with self._lock``) need no guard check
+
+    visit_AsyncWith = visit_With  # type: ignore[assignment]
+
+    # -- guarded attribute touches ----------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and attr in self.guarded:
+            locks = self.guarded[attr]
+            if not (locks & self.held):
+                lock = "|".join(sorted(locks))
+                access = (
+                    "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+                )
+                self.findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=self.unit.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{access} of self.{attr} (guarded_by: {lock}) "
+                            f"outside 'with self.{lock}' — unguarded "
+                            "cross-thread access; hold the lock, annotate the "
+                            f"method '# kvlint: holds={lock}' if the caller "
+                            "holds it, or suppress with a justification"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+    # -- blocking calls while a lock is held -------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            desc = self._blocking_desc(node.func)
+            if desc is not None:
+                locks = ", ".join(sorted(self.held))
+                self.findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=self.unit.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{desc} while holding self.{locks} — blocking "
+                            "under a lock convoys every other thread; move "
+                            "the call outside the critical section"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _blocking_desc(fn: ast.expr) -> Optional[str]:
+        if not isinstance(fn, ast.Attribute):
+            return None
+        root = fn.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            if root.id == "time" and fn.attr == "sleep":
+                return "time.sleep()"
+            if root.id in _DISPATCH_ROOTS:
+                return f"{root.id}.{fn.attr}() dispatch"
+        if fn.attr in _BLOCKING_ATTR_CALLS and not (
+            isinstance(fn.value, ast.Name) and fn.value.id == "time"
+        ):
+            return f".{fn.attr}() (socket/ZMQ)"
+        return None
+
+    # nested defs inherit the current held set lexically, which is what a
+    # closure invoked inline sees; closures stored for later are rare in
+    # this tree and suppressible where they occur.
+
+
+def check(unit: ModuleUnit, ctx: RepoContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guarded = _guarded_attrs(unit, node)
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # __init__ runs before the object is shared across threads;
+            # blocking-under-lock is still scanned there and everywhere.
+            method_guarded = {} if fn.name == "__init__" else guarded
+            lock_names = frozenset(n for alts in guarded.values() for n in alts)
+            visitor = _MethodVisitor(
+                unit, method_guarded, _held_at_def(unit, fn), lock_names
+            )
+            for stmt in fn.body:
+                visitor.visit(stmt)
+            findings.extend(visitor.findings)
+    return findings

@@ -1,0 +1,126 @@
+"""metric-pin: Prometheus exposition names are pinned to the docs catalog.
+
+Dashboards and alert rules dangle silently when an exposition name
+drifts. Every ``kvcache_*`` name constructed in the metric modules must
+appear as a catalog row in ``docs/observability.md`` (| `name` | ...),
+and every catalogued name must still exist in code — both directions, so
+neither the code nor the docs can rot alone.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.kvlint.core import Finding, ModuleUnit, RepoContext
+
+RULE = "metric-pin"
+
+DOCS_REL = "docs/observability.md"
+
+#: modules that construct Prometheus names (repo-relative path suffixes)
+METRIC_MODULES = (
+    "kvcache/metrics/collector.py",
+    "server/serve.py",
+)
+#: whole packages likewise in scope
+METRIC_PACKAGES = ("llm_d_kv_cache_manager_tpu/obs/",)
+
+_NAME_RE = re.compile(r"^kvcache_[a-z0-9_]+$")
+#: a catalog row: markdown table line whose first cell is a backticked name
+_CATALOG_ROW_RE = re.compile(r"^\|\s*`(kvcache_[a-z0-9_]+)`")
+
+
+def _in_scope(unit: ModuleUnit) -> bool:
+    return any(unit.rel.endswith(m) for m in METRIC_MODULES) or any(
+        p in unit.rel for p in METRIC_PACKAGES
+    )
+
+
+def _code_names(unit: ModuleUnit) -> list[tuple[str, int]]:
+    out = []
+    for node in ast.walk(unit.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _NAME_RE.match(node.value)
+        ):
+            out.append((node.value, node.lineno))
+    return out
+
+
+def _catalog_names(ctx: RepoContext) -> tuple[set[str], bool]:
+    cached = ctx.parsed_cache.get("metric_catalog")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    text = ctx.read_repo_file(DOCS_REL)
+    if text is None:
+        result: tuple[set[str], bool] = (set(), False)
+    else:
+        names = set()
+        for line in text.splitlines():
+            m = _CATALOG_ROW_RE.match(line.strip())
+            if m:
+                names.add(m.group(1))
+        result = (names, True)
+    ctx.parsed_cache["metric_catalog"] = result
+    return result
+
+
+def check(unit: ModuleUnit, ctx: RepoContext) -> list[Finding]:
+    if not _in_scope(unit):
+        return []
+    catalog, docs_ok = _catalog_names(ctx)
+    if not docs_ok:
+        return [
+            Finding(
+                rule=RULE,
+                path=unit.rel,
+                line=1,
+                message=f"metric catalog {DOCS_REL} is missing or unreadable",
+            )
+        ]
+    findings = []
+    for name, line in _code_names(unit):
+        if name not in catalog:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.rel,
+                    line=line,
+                    message=(
+                        f"Prometheus name '{name}' has no catalog row in "
+                        f"{DOCS_REL} — add a `| \\`{name}\\` | ... |` row "
+                        "(type, labels, meaning) so dashboards stay honest"
+                    ),
+                )
+            )
+    return findings
+
+
+def check_repo(ctx: RepoContext) -> list[Finding]:
+    """Docs → code direction: run only when every metric module was
+    scanned this invocation (a file-scoped run can't prove absence)."""
+    scoped = [u for u in ctx.units if _in_scope(u)]
+    covered = {m for m in METRIC_MODULES if any(u.rel.endswith(m) for u in scoped)}
+    if covered != set(METRIC_MODULES):
+        return []
+    catalog, docs_ok = _catalog_names(ctx)
+    if not docs_ok:
+        return []
+    in_code = {name for u in scoped for name, _ in _code_names(u)}
+    findings = []
+    for name in sorted(catalog - in_code):
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=DOCS_REL,
+                line=1,
+                message=(
+                    f"catalogued metric '{name}' is no longer constructed in "
+                    "the metric modules — remove the stale row or restore the "
+                    "metric (renames break deployed dashboards)"
+                ),
+            )
+        )
+    return findings

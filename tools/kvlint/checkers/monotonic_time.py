@@ -1,0 +1,61 @@
+"""monotonic-time: rate/deadline/backoff math must not read the wall clock.
+
+``time.time()`` steps under NTP slew; a deadline computed from it can
+fire early, late, or never — the breaker backoff and Retry-After bugs
+this rule exists for. Every ``time.time()`` call is flagged; the only
+legitimate uses are timestamps that cross the wire or are shown to
+humans, and those carry a justified ``# kvlint: disable=monotonic-time``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.kvlint.core import Finding, ModuleUnit, RepoContext
+
+RULE = "monotonic-time"
+
+#: module aliases ``time`` travels under in this tree
+_TIME_NAMES = {"time", "_time"}
+
+
+def check(unit: ModuleUnit, ctx: RepoContext) -> list[Finding]:
+    findings: list[Finding] = []
+    # ``from time import time`` style — only if the module imports the
+    # function by name (heuristic: a bare-name call is then the imported
+    # function). Computed once; the node loop below only consults it.
+    imports_bare_time = any(
+        isinstance(n, ast.ImportFrom)
+        and n.module == "time"
+        and any(a.name == "time" for a in n.names)
+        for n in ast.walk(unit.tree)
+    )
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = False
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "time"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in _TIME_NAMES
+        ):
+            hit = True  # time.time()
+        elif isinstance(fn, ast.Name) and fn.id == "time":
+            hit = imports_bare_time
+        if hit:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.rel,
+                    line=node.lineno,
+                    message=(
+                        "time.time() in library code: use time.monotonic() for "
+                        "rate/deadline/backoff arithmetic; wall clock is only "
+                        "for timestamps that cross the wire (suppress with a "
+                        "justification if so)"
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,265 @@
+"""wire-append-only: msgpack frames may only grow optional trailing fields.
+
+Decoders across the fleet are positional and tolerant: old readers index
+into the frame array and ignore trailing extras. That contract survives
+exactly one kind of evolution — appending optional fields at the end.
+This checker extracts the positional field order each wire builder emits
+(the list literal plus any conditional ``append``/``extend`` tails, which
+ARE the optional-trailing-field idiom) and compares it against the
+committed manifest ``tools/kvlint/wire_manifest.json``:
+
+- a committed field moved, changed, or disappeared  → flagged (reorder /
+  insertion / removal breaks every deployed decoder)
+- a new trailing field not yet in the manifest      → flagged until the
+  manifest is updated, so the append is a reviewed, diff-visible act
+- a builder the manifest doesn't know               → flagged
+
+Covered modules: ``kvcache/transfer/protocol.py`` and
+``kvcache/kvevents/events.py`` (the payload builders).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import Optional
+
+from tools.kvlint.core import Finding, ModuleUnit, RepoContext
+
+RULE = "wire-append-only"
+
+MANIFEST_REL = "tools/kvlint/wire_manifest.json"
+
+#: modules whose frames are pinned (matched by repo-relative path suffix)
+WIRE_MODULES = (
+    "kvcache/transfer/protocol.py",
+    "kvcache/kvevents/events.py",
+)
+
+#: wire-builder function name shapes
+_BUILDER_NAMES = ("to_tagged_union", "to_payload")
+_BUILDER_PREFIX = "encode_"
+
+
+def _is_wire_module(unit: ModuleUnit) -> bool:
+    return any(unit.rel.endswith(m) for m in WIRE_MODULES)
+
+
+def _module_key(unit: ModuleUnit) -> str:
+    for m in WIRE_MODULES:
+        if unit.rel.endswith(m):
+            return m
+    return unit.rel
+
+
+def _load_manifest(ctx: RepoContext) -> Optional[dict]:
+    text = ctx.read_repo_file(MANIFEST_REL)
+    if text is None:
+        return None
+    try:
+        return json.loads(text)
+    except ValueError:
+        return None
+
+
+def _packb_list(call: ast.Call) -> Optional[ast.List]:
+    """``msgpack.packb([...], ...)`` → the frame list literal."""
+    fn = call.func
+    if (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "packb"
+        and call.args
+        and isinstance(call.args[0], ast.List)
+    ):
+        return call.args[0]
+    return None
+
+
+def _extract_frames(fn: ast.FunctionDef) -> dict[str, tuple[int, list[str]]]:
+    """frame-name -> (lineno, ordered field expressions).
+
+    Frames are list variables later ``append``/``extend``-ed (conditionals
+    included — a conditional tail is the optional-field idiom and stays
+    positional), plus any list literal passed straight to ``msgpack.packb``
+    (keyed ``return``).
+    """
+    frames: dict[str, tuple[int, list[str]]] = {}
+
+    def fields_of(lst: ast.List) -> list[str]:
+        return [ast.unparse(e) for e in lst.elts]
+
+    def visit(stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            # frame start: <name> = [ ... ]  (plain or annotated)
+            target: Optional[str] = None
+            value: Optional[ast.expr] = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+                isinstance(stmt.targets[0], ast.Name)
+            ):
+                target, value = stmt.targets[0].id, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                target, value = stmt.target.id, stmt.value
+            if (
+                target is not None
+                and isinstance(value, ast.List)
+                and target not in frames
+            ):
+                frames[target] = (stmt.lineno, fields_of(value))
+
+            # frame growth: <name>.append(x) / <name>.extend([...])
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                f = call.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in frames
+                    and call.args
+                ):
+                    line, flds = frames[f.value.id]
+                    if f.attr == "append":
+                        frames[f.value.id] = (
+                            line,
+                            flds + [ast.unparse(call.args[0])],
+                        )
+                    elif f.attr == "extend" and isinstance(call.args[0], ast.List):
+                        frames[f.value.id] = (
+                            line,
+                            flds + fields_of(call.args[0]),
+                        )
+
+            # direct frames: ``return [ ... ]`` and ``msgpack.packb([...])``
+            if (
+                isinstance(stmt, ast.Return)
+                and isinstance(stmt.value, ast.List)
+                and "return" not in frames
+            ):
+                frames["return"] = (stmt.lineno, fields_of(stmt.value))
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    lst = _packb_list(sub)
+                    if lst is not None and "return" not in frames:
+                        frames["return"] = (sub.lineno, fields_of(lst))
+
+            # recurse into nested blocks in order
+            for attr in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, attr, None)
+                if inner:
+                    visit(inner)
+            for handler in getattr(stmt, "handlers", ()):
+                visit(handler.body)
+    visit(fn.body)
+    return frames
+
+
+def _wire_builders(unit: ModuleUnit) -> dict[str, ast.FunctionDef]:
+    """qualname -> builder FunctionDef."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in unit.tree.body:
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name in _BUILDER_NAMES:
+                    out[f"{node.name}.{sub.name}"] = sub
+        elif isinstance(node, ast.FunctionDef) and (
+            node.name in _BUILDER_NAMES or node.name.startswith(_BUILDER_PREFIX)
+        ):
+            out[node.name] = node
+    return out
+
+
+def check(unit: ModuleUnit, ctx: RepoContext) -> list[Finding]:
+    if not _is_wire_module(unit):
+        return []
+    manifest = _load_manifest(ctx)
+    if manifest is None:
+        return [
+            Finding(
+                rule=RULE,
+                path=unit.rel,
+                line=1,
+                message=f"missing or unreadable wire manifest {MANIFEST_REL}",
+            )
+        ]
+    mod_key = _module_key(unit)
+    pinned: dict = manifest.get(mod_key, {})
+    findings: list[Finding] = []
+
+    builders = _wire_builders(unit)
+    for qualname, fn in builders.items():
+        frames = _extract_frames(fn)
+        pinned_frames: dict = pinned.get(qualname, {})
+        for frame, (line, got) in frames.items():
+            want = pinned_frames.get(frame)
+            if want is None:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.rel,
+                        line=line,
+                        message=(
+                            f"wire frame {qualname}[{frame}] not in "
+                            f"{MANIFEST_REL} — declare its field order there"
+                        ),
+                    )
+                )
+                continue
+            if got[: len(want)] != want:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.rel,
+                        line=line,
+                        message=(
+                            f"wire frame {qualname}[{frame}] reorders/mutates "
+                            f"committed fields: manifest pins {want}, code "
+                            f"emits {got} — deployed positional decoders "
+                            "break; only optional TRAILING fields may be added"
+                        ),
+                    )
+                )
+            elif len(got) > len(want):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.rel,
+                        line=line,
+                        message=(
+                            f"wire frame {qualname}[{frame}] grew trailing "
+                            f"field(s) {got[len(want):]} — append them to "
+                            f"{MANIFEST_REL} (reviewed, append-only) and "
+                            "ensure decoders tolerate their absence"
+                        ),
+                    )
+                )
+        # committed frames the code no longer emits
+        for frame, want in pinned_frames.items():
+            if frame not in frames:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.rel,
+                        line=fn.lineno,
+                        message=(
+                            f"wire frame {qualname}[{frame}] is pinned in the "
+                            "manifest but no longer built — removing a frame "
+                            "breaks deployed peers"
+                        ),
+                    )
+                )
+    # committed builders that vanished from the module
+    for qualname in pinned:
+        if qualname not in builders:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.rel,
+                    line=1,
+                    message=(
+                        f"wire builder {qualname} is pinned in the manifest "
+                        "but absent from the module"
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,211 @@
+"""kvlint core: file loading, suppression handling, checker registry, runner.
+
+A checker is a module in ``tools/kvlint/checkers`` exposing
+
+- ``RULE``: the rule name (kebab-case, what suppression comments name)
+- ``check(unit, ctx) -> list[Finding]``: per-file pass
+- optionally ``check_repo(ctx) -> list[Finding]``: one cross-file pass per
+  run (e.g. the docs→code direction of metric-pin)
+
+Suppressions: a trailing ``# kvlint: disable=rule`` (or comma-separated
+list) drops that rule's findings on its line; the same comment on a line
+of its own covers the NEXT line (the noqa-above-the-line habit must not
+silently widen scope). File scope requires the explicit
+``# kvlint: disable-file=rule`` form. Every suppression in tree code is
+expected to carry a human justification alongside it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+_SUPPRESS_RE = re.compile(r"#\s*kvlint:\s*disable=([a-z0-9,\-\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*kvlint:\s*disable-file=([a-z0-9,\-\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class ModuleUnit:
+    """One parsed source file plus its suppression map."""
+
+    path: Path
+    rel: str
+    source: str
+    lines: list[str]
+    tree: ast.Module
+    #: line number -> set of suppressed rules on that line
+    line_suppress: dict[int, set[str]] = field(default_factory=dict)
+    #: rules suppressed for the entire file
+    file_suppress: set[str] = field(default_factory=set)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppress:
+            return True
+        return rule in self.line_suppress.get(line, set())
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+@dataclass
+class RepoContext:
+    """Run-wide state shared by checkers."""
+
+    repo_root: Path
+    units: list[ModuleUnit]
+    #: read_repo_file cache: one disk read per repo file per run, not per
+    #: linted module (the allowlist/manifest/docs are re-consulted by
+    #: every file a checker visits)
+    _file_cache: dict[str, Optional[str]] = field(default_factory=dict)
+    #: scratch space for checkers to memoise parsed artifacts per run
+    parsed_cache: dict[str, object] = field(default_factory=dict)
+
+    def read_repo_file(self, rel: str) -> Optional[str]:
+        if rel not in self._file_cache:
+            try:
+                self._file_cache[rel] = (self.repo_root / rel).read_text(
+                    encoding="utf-8"
+                )
+            except OSError:
+                self._file_cache[rel] = None
+        return self._file_cache[rel]
+
+
+def _parse_suppressions(lines: list[str]) -> tuple[dict[int, set[str]], set[str]]:
+    per_line: dict[int, set[str]] = {}
+    whole_file: set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        mf = _SUPPRESS_FILE_RE.search(text)
+        if mf:
+            # File scope only via the explicit form — the module-wide
+            # exemption must be unmistakable in review.
+            whole_file |= {r.strip() for r in mf.group(1).split(",") if r.strip()}
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        if text.lstrip().startswith("#"):
+            # A standalone suppression comment covers the NEXT line (the
+            # flake8 noqa-above-the-line habit) — never the whole file.
+            per_line.setdefault(i + 1, set()).update(rules)
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, whole_file
+
+
+def load_unit(path: Path, repo_root: Path = REPO_ROOT) -> ModuleUnit:
+    try:
+        source = path.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError) as exc:
+        # Undecodable/unparsable files are reported, not skipped silently.
+        raise RuntimeError(f"kvlint cannot parse {path}: {exc}") from exc
+    lines = source.splitlines()
+    per_line, whole_file = _parse_suppressions(lines)
+    try:
+        rel = str(path.resolve().relative_to(repo_root))
+    except ValueError:
+        rel = str(path)
+    return ModuleUnit(
+        path=path,
+        rel=rel,
+        source=source,
+        lines=lines,
+        tree=tree,
+        line_suppress=per_line,
+        file_suppress=whole_file,
+    )
+
+
+def iter_py_files(targets: Iterable[str]) -> list[Path]:
+    out: list[Path] = []
+    for t in targets:
+        p = Path(t)
+        if p.is_dir():
+            found = sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            )
+            if not found:
+                # A directory with nothing to lint is almost certainly a
+                # typo'd/renamed path — exiting 0 would turn the CI gate
+                # into a silent no-op forever.
+                print(f"kvlint: no .py files under {t!r}", file=sys.stderr)
+                raise SystemExit(2)
+            out.extend(found)
+        elif p.is_file() and p.suffix == ".py":
+            out.append(p)
+        else:
+            print(
+                f"kvlint: {t!r} is not a .py file or a directory",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+    return out
+
+
+def all_rules() -> dict[str, object]:
+    """Rule name -> checker module, in deterministic order."""
+    from tools.kvlint.checkers import (
+        knob_default,
+        lock_discipline,
+        metric_pin,
+        monotonic_time,
+        wire_append_only,
+    )
+
+    mods = [
+        knob_default,
+        wire_append_only,
+        metric_pin,
+        lock_discipline,
+        monotonic_time,
+    ]
+    return {m.RULE: m for m in mods}
+
+
+def lint_paths(
+    targets: Iterable[str],
+    rules: Optional[Iterable[str]] = None,
+    repo_root: Path = REPO_ROOT,
+) -> list[Finding]:
+    checkers = all_rules()
+    if rules is not None:
+        unknown = set(rules) - set(checkers)
+        if unknown:
+            raise SystemExit(f"kvlint: unknown rule(s): {', '.join(sorted(unknown))}")
+        checkers = {k: v for k, v in checkers.items() if k in set(rules)}
+
+    units = [load_unit(p, repo_root) for p in iter_py_files(targets)]
+    ctx = RepoContext(repo_root=repo_root, units=units)
+
+    findings: list[Finding] = []
+    for rule, mod in checkers.items():
+        for unit in units:
+            for f in mod.check(unit, ctx):
+                if not unit.suppressed(rule, f.line):
+                    findings.append(f)
+        check_repo = getattr(mod, "check_repo", None)
+        if check_repo is not None:
+            findings.extend(check_repo(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
