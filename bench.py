@@ -97,6 +97,19 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
   BENCH_STEP_PHASES=1  per-arm engine step-phase decomposition
                        (schedule/prefill/decode/sample/gather/publish
                        seconds) in the detail JSON
+  BENCH_DISAGG=1       disaggregated prefill/decode arm (ISSUE 9): the
+                       same qps-ramp workload served by N prefill + M
+                       decode pods — the TwoHopPlanner places ingest on
+                       the prefill tier (warmth + measured prefill rate),
+                       the chain moves over the real export/import
+                       endpoints (charged wall + modeled link time), and
+                       the decode tier streams tokens. Decode-tier ITL is
+                       the headline: ingest never shares an engine with a
+                       decode lane, so the interference chunked prefill
+                       bounds is REMOVED, not amortized. Compared against
+                       the same-total-pod-count mixed fleet (`precise`)
+  BENCH_DISAGG_PREFILL_PODS=N  prefill-tier size (default n_pods/2,
+                       min 1); decode tier gets the rest
 """
 
 from __future__ import annotations
@@ -584,6 +597,174 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     }
 
 
+def run_disagg(
+    workload, params, engine_cfg, n_prefill, n_decode, max_new_tokens,
+    link_gbps,
+):
+    """Disaggregated prefill/decode fleet over the same workload: N
+    prefill pods run ingest and stop at the first token; each finished
+    chain is handed off over the real engine export/import endpoints
+    (charged the measured wall time plus the modeled DCN link, exactly
+    like BENCH_TRANSFER) and the decode tier streams the remaining
+    tokens. Placement is THE PRODUCT PATH (kvcache/router.TwoHopPlanner:
+    warmth + measured prefill rate for the prefill hop, queue-depth
+    headroom for the decode hop)."""
+    from llm_d_kv_cache_manager_tpu.kvcache import (
+        KVCacheIndexer,
+        KVCacheIndexerConfig,
+        PodView,
+        TwoHopPlanner,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import TokenProcessorConfig
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    page = engine_cfg.block_manager.page_size
+    indexer = KVCacheIndexer(
+        KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=page))
+    )
+    n_pods = n_prefill + n_decode
+    pool, publish = make_event_pipeline(indexer.kv_block_index, n_pods)
+    lag_s = float(os.environ.get("BENCH_EVENT_LAG_MS", "2")) / 1000.0
+    bus = LaggedEventBus(pool, lag_s)
+    pods = [Pod(i, engine_cfg, params, publish, bus) for i in range(n_pods)]
+    prefill_pods = {f"tpu-pod-{i}": pods[i] for i in range(n_prefill)}
+    decode_pods = {
+        f"tpu-pod-{i}": pods[i] for i in range(n_prefill, n_pods)
+    }
+    planner = TwoHopPlanner(
+        score_fn=lambda toks, names: indexer.score_tokens(toks, MODEL_NAME, names)
+    )
+    link_bytes_s = link_gbps * 1e9 / 8
+
+    def views():
+        vs = [
+            PodView(
+                name, role="prefill", transfer_endpoint=name,
+                queue_depth=pod.load, prefill_rate=pod.engine._prefill_rate,
+            )
+            for name, pod in prefill_pods.items()
+        ]
+        vs += [
+            PodView(name, role="decode", queue_depth=pod.load)
+            for name, pod in decode_pods.items()
+        ]
+        return vs
+
+    ttfts: dict[int, float] = {}
+    arrivals: dict[int, float] = {}
+    #: prefill-hop seq -> (prompt tokens, source pod, decode pod name)
+    pending: dict[int, tuple] = {}
+    handoff = {"count": 0, "blocks": 0, "transfer_s": 0.0, "replans": 0}
+    cont_sampling = SamplingParams(max_new_tokens=max_new_tokens - 1)
+
+    def process_handoffs():
+        """Move every finished prefill hop's chain to its decode pod and
+        admit the continuation there (virtual clocks charged: the decode
+        pod cannot admit before the chain existed, nor before its own
+        clock, and it pays the measured export/import wall + link time)."""
+        for sid in list(pending):
+            seq, tokens, src, dec_name = pending[sid]
+            if not seq.is_finished():
+                continue
+            del pending[sid]
+            tgt = decode_pods[dec_name]
+            hashes = indexer.token_processor.prefix_hashes(tokens)
+            t0 = time.perf_counter()
+            blocks = src.engine.export_kv_blocks(hashes)
+            n_imp = tgt.engine.import_kv_blocks(blocks)
+            wall = time.perf_counter() - t0
+            wire = sum(b.wire_bytes for b in blocks)
+            link_s = wire / link_bytes_s if wire and link_bytes_s else 0.0
+            ready_at = src.finish_clock.get(sid, src.clock)
+            tgt.clock = max(tgt.clock, ready_at) + wall + link_s
+            cont = tgt.engine.add_request(
+                tokens + seq.generated_tokens, cont_sampling
+            )
+            tgt.seqs.append(cont)
+            handoff["count"] += 1
+            handoff["blocks"] += n_imp
+            handoff["transfer_s"] += wall + link_s
+
+    for t, _seg, tokens in workload:
+        for pod in pods:
+            pod.advance_to(t, ttfts, arrivals)
+        process_handoffs()
+        bus.release(t)
+        plan = planner.plan(tokens, views())
+        src = prefill_pods[plan.prefill_pod]
+        dec_name = plan.decode_pod
+        if not src.engine.has_work:
+            src.clock = max(src.clock, t)
+        seq = src.engine.add_request(tokens, SamplingParams(max_new_tokens=1))
+        src.seqs.append(seq)
+        arrivals[seq.seq_id] = t
+        pending[seq.seq_id] = (seq, tokens, src, dec_name)
+    while True:
+        for pod in pods:
+            pod.drain(ttfts, arrivals)
+        process_handoffs()
+        if not pending and not any(p.engine.has_work for p in pods):
+            break
+    bus.flush_all()
+    pool.drain(timeout=10.0)
+    pool.shutdown()
+    indexer.shutdown()
+
+    n_req = len(workload)
+    assert len(ttfts) == n_req, f"lost requests: {len(ttfts)}/{n_req}"
+    all_ttfts = np.asarray(list(ttfts.values()))
+    makespan = max(p.clock for p in pods)
+    # Decode-tier ITL: the isolation headline — continuation lanes never
+    # share an engine with 2k-token ingest, so their inter-token gaps are
+    # pure decode cadence (plus the handoff's own admission prefill).
+    itls = np.asarray(
+        [
+            (p.finish_clock[s.seq_id] - p.first_clock[s.seq_id])
+            / (s.num_generated - 1)
+            for p in decode_pods.values()
+            for s in p.seqs
+            if s.num_generated > 1
+            and s.seq_id in p.first_clock
+            and s.seq_id in p.finish_clock
+        ]
+    )
+    # Workload cache behavior is measured at the INGEST tier only: the
+    # decode pods' prompt+[t1] continuations cache-hit the just-imported
+    # chain by construction, so counting them would add a ~100%-hit entry
+    # per request and inflate the rate vs the mixed arms' definition
+    # (shared-prefix reuse at first prefill).
+    prompt_tokens = sum(
+        n for p in prefill_pods.values() for _, n in p.hit_stats.values()
+    )
+    cached_tokens = sum(
+        c for p in prefill_pods.values() for c, _ in p.hit_stats.values()
+    )
+    out_tokens = sum(len(s.output_tokens) for p in pods for s in p.seqs)
+    res = {
+        "n_prefill": n_prefill,
+        "n_decode": n_decode,
+        "p50_ttft_s": float(np.median(all_ttfts)),
+        "p90_ttft_s": float(np.percentile(all_ttfts, 90)),
+        "p50_itl_s": float(np.median(itls)) if itls.size else None,
+        "p90_itl_s": float(np.percentile(itls, 90)) if itls.size else None,
+        "p99_itl_s": float(np.percentile(itls, 99)) if itls.size else None,
+        "req_s_per_chip": float(n_req / makespan / n_pods) if makespan else 0.0,
+        "output_tok_s_per_chip": (
+            float(out_tokens / makespan / n_pods) if makespan else 0.0
+        ),
+        "prefix_cache_hit_rate": (
+            float(cached_tokens / prompt_tokens) if prompt_tokens else 0.0
+        ),
+        "makespan_s": float(makespan),
+        "handoffs": handoff["count"],
+        "handoff_blocks": handoff["blocks"],
+        "handoff_transfer_s": round(handoff["transfer_s"], 3),
+    }
+    pods.clear()
+    gc.collect()
+    return res
+
+
 def warmup(params, engine_cfg, prefix_len, suffix_len, vocab, max_new_tokens):
     """Compile every jit shape the measured runs will hit (cold prefill,
     warm suffix-only prefill, mixed batch, decode) on a scratch engine."""
@@ -845,6 +1026,27 @@ def main() -> int:
                 "precise", workload, params, host_cfg, n_pods, max_new
             )
 
+    # -- Disaggregated prefill/decode arm (ISSUE 9) -----------------------
+    # Same workload, same total pod count, but the fleet is split into a
+    # prefill tier (ingest at full batch width, stop at first token) and a
+    # decode tier (pull the chain, stream tokens). The comparison against
+    # the mixed `precise` fleet is the isolation headline: decode-tier ITL
+    # with ingest REMOVED from decode engines vs merely chunked/batched in.
+    disagg_result = None
+    n_disagg_prefill = 0
+    if os.environ.get("BENCH_DISAGG", "0") == "1":
+        n_disagg_prefill = int(
+            os.environ.get(
+                "BENCH_DISAGG_PREFILL_PODS", str(max(n_pods // 2, 1))
+            )
+        )
+        n_disagg_prefill = min(max(n_disagg_prefill, 1), n_pods - 1)
+        disagg_result = run_disagg(
+            workload, params, engine_cfg,
+            n_disagg_prefill, n_pods - n_disagg_prefill, max_new,
+            link_gbps=float(os.environ.get("BENCH_TRANSFER_GBPS", "10")),
+        )
+
     # Headline metrics are precise-vs-round_robin by definition: when a
     # BENCH_POLICIES subset omits either, the corresponding fields are
     # null rather than silently reporting another policy's numbers.
@@ -890,6 +1092,7 @@ def main() -> int:
         "pressure_total_pages": pressure_pages,
         "pressure_host_pages": pressure_host_pages,
         "pressure_results": pressure_results,
+        "disagg": disagg_result,
     }
     print(json.dumps(detail), file=sys.stderr)
 
@@ -969,6 +1172,35 @@ def main() -> int:
                     else None
                 ),
                 "pressure": pressure,
+                # Disagg arm headline (null unless BENCH_DISAGG ran): the
+                # decode-tier ITL isolation win over the same-size mixed
+                # fleet, and the two-hop placement/handoff accounting.
+                "disagg": (
+                    {
+                        "n_prefill": disagg_result["n_prefill"],
+                        "n_decode": disagg_result["n_decode"],
+                        "p90_itl_s": (
+                            round(disagg_result["p90_itl_s"], 4)
+                            if disagg_result["p90_itl_s"] is not None
+                            else None
+                        ),
+                        "p50_ttft_s": round(disagg_result["p50_ttft_s"], 4),
+                        "handoffs": disagg_result["handoffs"],
+                        "p90_itl_mixed_over_disagg": (
+                            round(
+                                precise["p90_itl_s"]
+                                / disagg_result["p90_itl_s"],
+                                3,
+                            )
+                            if precise is not None
+                            and precise.get("p90_itl_s")
+                            and disagg_result["p90_itl_s"]
+                            else None
+                        ),
+                    }
+                    if disagg_result is not None
+                    else None
+                ),
             }
         )
     )

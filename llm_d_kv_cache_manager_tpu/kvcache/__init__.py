@@ -1,7 +1,15 @@
 from . import kvblock  # noqa: F401
 from . import transfer  # noqa: F401
 from .indexer import KVCacheIndexer, KVCacheIndexerConfig
-from .router import BlendedRouter, PrefixAffinityTracker, RoutingDecision
+from .router import (
+    BlendedRouter,
+    DisaggPlan,
+    PlanError,
+    PodView,
+    PrefixAffinityTracker,
+    RoutingDecision,
+    TwoHopPlanner,
+)
 from .scorer import (
     KVBlockScorer,
     KVBlockScorerConfig,
@@ -12,6 +20,10 @@ from .scorer import (
 
 __all__ = [
     "BlendedRouter",
+    "DisaggPlan",
+    "PlanError",
+    "PodView",
+    "TwoHopPlanner",
     "PrefixAffinityTracker",
     "RoutingDecision",
     "kvblock",

@@ -96,10 +96,14 @@ class KVCacheIndexer:
         prompt: str,
         model_name: str,
         pod_identifiers: Optional[Sequence[str]] = None,
+        placement: Optional[str] = None,
     ) -> dict[str, int]:
         """Score candidate pods by longest consecutive cached-prefix match
         for ``prompt``. Empty/None ``pod_identifiers`` scores all known pods.
-        """
+        ``placement`` ("prefill"/"decode"; None = legacy, role-blind)
+        excludes pods whose heartbeat-advertised role cannot serve that
+        tier — a prefill-only pod never wins decode placement and vice
+        versa (disaggregated serving)."""
         tokens = self.tokenization_pool.tokenize(prompt, model_name)
         log.debug("tokenized prompt", n_tokens=len(tokens), model=model_name)
 
@@ -109,7 +113,7 @@ class KVCacheIndexer:
             return {}
 
         pod_filter = set(pod_identifiers) if pod_identifiers else set()
-        scores = self._lookup_and_score(block_keys, pod_filter)
+        scores = self._lookup_and_score(block_keys, pod_filter, placement)
         log.debug("scored pods", scores=scores)
         return scores
 
@@ -118,6 +122,7 @@ class KVCacheIndexer:
         tokens: Sequence[int],
         model_name: str,
         pod_identifiers: Optional[Sequence[str]] = None,
+        placement: Optional[str] = None,
     ) -> dict[str, int]:
         """Scoring entry for callers that already hold token ids (the in-tree
         JAX server's router path — skips the tokenizer pool hop)."""
@@ -129,28 +134,35 @@ class KVCacheIndexer:
             if not hashes:
                 return {}
             return self._filter_expired(
-                self._fused_hash_score(model_name, hashes, pod_filter)
+                self._fused_hash_score(model_name, hashes, pod_filter), placement
             )
         block_keys = self.token_processor.tokens_to_kv_block_keys(tokens, model_name)
         if not block_keys:
             return {}
-        return self._lookup_and_score(block_keys, pod_filter)
+        return self._lookup_and_score(block_keys, pod_filter, placement)
 
-    def _filter_expired(self, scores: dict[str, int]) -> dict[str, int]:
+    def _filter_expired(
+        self, scores: dict[str, int], placement: Optional[str] = None
+    ) -> dict[str, int]:
         """Routability guard: an expired, drained, or draining pod must
         never win routing, even when its swept-in-the-index state lags its
         expiry (sweeper cadence) or its entries have not been evicted yet
-        (drain still in progress)."""
+        (drain still in progress). ``placement`` adds the role gate."""
         if self.fleet_health is None or not scores:
             return scores
-        return self.fleet_health.filter_scores(scores)
+        return self.fleet_health.filter_scores(scores, placement)
 
     def _lookup_and_score(
-        self, block_keys: list[Key], pod_filter: set[str]
+        self,
+        block_keys: list[Key],
+        pod_filter: set[str],
+        placement: Optional[str] = None,
     ) -> dict[str, int]:
         if self._fused_score is not None:
             scores = self._fused_score(block_keys, pod_filter)
             if scores is not None:
-                return self._filter_expired(scores)
+                return self._filter_expired(scores, placement)
         key_to_pods = self.kv_block_index.lookup(block_keys, pod_filter)
-        return self._filter_expired(self.scorer.score(block_keys, key_to_pods))
+        return self._filter_expired(
+            self.scorer.score(block_keys, key_to_pods), placement
+        )

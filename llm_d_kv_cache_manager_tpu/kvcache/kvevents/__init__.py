@@ -7,6 +7,7 @@ from .events import (
     Heartbeat,
     IndexSnapshot,
     PodDrained,
+    PrefillComplete,
     decode_event_batch,
 )
 from .health import FleetHealth, FleetHealthConfig
@@ -23,6 +24,7 @@ __all__ = [
     "Heartbeat",
     "IndexSnapshot",
     "PodDrained",
+    "PrefillComplete",
     "decode_event_batch",
     "FleetHealth",
     "FleetHealthConfig",

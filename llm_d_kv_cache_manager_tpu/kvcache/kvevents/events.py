@@ -29,6 +29,22 @@ old subscribers simply skip the unknown tags):
   evicts the pod from the index immediately (no ``POD_TTL_S`` wait) and
   ``FleetHealth`` marks it drained so the scorer never routes to it.
 
+Disaggregated serving extensions (ISSUE 9; on the wire only when
+``POD_ROLE`` is set, so default traffic stays bit-identical):
+
+- ``Heartbeat`` grows a trailing ``role`` field (``"prefill"`` /
+  ``"decode"``; ``mixed``, the default, is never encoded) so the scorer
+  can keep prefill-only pods out of decode placement and vice versa.
+  The ``draining`` position is filled (with ``False`` when needed) only
+  when a role follows it — a role-less, non-draining heartbeat's bytes
+  are unchanged.
+- ``PrefillComplete``: ``["PrefillComplete", request_id, num_blocks]`` —
+  a prefill-role pod finished a request's ingest (stopped at the first
+  token) and the prompt's block chain is registered and exportable over
+  the transfer fabric. The handoff itself rides the serving plane; this
+  event lets the fleet (and the bench/chaos harnesses) observe handoff
+  supply without polling pods, and proves liveness like any message.
+
 Decoding is positional and tolerant: trailing optional fields may be absent
 (the reference's "legacy" variants, ``events.go:113-153``) and unknown extra
 fields are ignored — this subsumes the reference's arity-sniffing legacy
@@ -48,6 +64,10 @@ ALL_BLOCKS_CLEARED_TAG = "AllBlocksCleared"
 HEARTBEAT_TAG = "Heartbeat"
 INDEX_SNAPSHOT_TAG = "IndexSnapshot"
 POD_DRAINED_TAG = "PodDrained"
+PREFILL_COMPLETE_TAG = "PrefillComplete"
+
+#: roles a pod may advertise (anything else decodes to None = mixed)
+POD_ROLES = ("prefill", "decode", "mixed")
 
 
 @dataclass
@@ -93,11 +113,19 @@ class Heartbeat:
     #: pod is mid-drain: stop routing to it (encoded only when true so a
     #: non-draining heartbeat's wire bytes are identical to previous rounds)
     draining: bool = False
+    #: advertised serving role ("prefill"/"decode"; None = mixed, the
+    #: default, never encoded). Drives the scorer's placement filter and
+    #: the two-hop planner's tier split. Trailing-append: the draining
+    #: position before it is filled only when a role follows, so role-less
+    #: heartbeat bytes stay bit-identical legacy.
+    role: Optional[str] = None
 
     def to_tagged_union(self) -> list[Any]:
         arr: list[Any] = [HEARTBEAT_TAG, self.dropped_batches]
-        if self.draining:
-            arr.append(True)
+        if self.draining or self.role is not None:
+            arr.append(bool(self.draining))
+        if self.role is not None:
+            arr.append(self.role)
         return arr
 
 
@@ -121,6 +149,23 @@ class PodDrained:
         return [POD_DRAINED_TAG]
 
 
+@dataclass
+class PrefillComplete:
+    """A prefill-role pod finished a request's ingest: the prompt's block
+    chain is registered locally and exportable over the transfer fabric.
+    Observation-only on the index (the chain's ``BlockStored`` events are
+    the locality truth); ``FleetHealth`` counts it as handoff supply and
+    as liveness. Published only by role-enabled pods — absent from all
+    default wire traffic."""
+
+    request_id: str = ""
+    #: full prompt pages registered for the chain (export upper bound)
+    num_blocks: int = 0
+
+    def to_tagged_union(self) -> list[Any]:
+        return [PREFILL_COMPLETE_TAG, self.request_id, self.num_blocks]
+
+
 Event = Union[
     BlockStored,
     BlockRemoved,
@@ -128,6 +173,7 @@ Event = Union[
     Heartbeat,
     IndexSnapshot,
     PodDrained,
+    PrefillComplete,
 ]
 
 
@@ -198,7 +244,12 @@ def _decode_event(raw) -> Optional[Event]:
         draining = _get(fields, 1, False)
         if not isinstance(draining, bool):
             draining = False
-        return Heartbeat(dropped_batches=dropped, draining=draining)
+        role = _get(fields, 2)
+        if isinstance(role, bytes):
+            role = role.decode("utf-8", "replace")
+        if role not in POD_ROLES:
+            role = None  # tolerant: an unknown role never breaks liveness
+        return Heartbeat(dropped_batches=dropped, draining=draining, role=role)
     if tag == INDEX_SNAPSHOT_TAG:
         raw_digest = _get(fields, 0)
         if not isinstance(raw_digest, dict):
@@ -213,6 +264,16 @@ def _decode_event(raw) -> Optional[Event]:
         return IndexSnapshot(blocks_by_medium=digest)
     if tag == POD_DRAINED_TAG:
         return PodDrained()
+    if tag == PREFILL_COMPLETE_TAG:
+        rid = _get(fields, 0, "")
+        if isinstance(rid, bytes):
+            rid = rid.decode("utf-8", "replace")
+        if not isinstance(rid, str):
+            rid = ""
+        n = _get(fields, 1, 0)
+        if not isinstance(n, int) or isinstance(n, bool):
+            n = 0
+        return PrefillComplete(request_id=rid, num_blocks=n)
     return None  # unknown tag
 
 

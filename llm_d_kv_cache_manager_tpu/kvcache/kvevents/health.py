@@ -71,6 +71,10 @@ class _PodState:
     #: pod published its PodDrained goodbye; treated as expired immediately.
     #: Clears on any new message (the pod restarted under the same identity).
     drained: bool = False
+    #: serving role advertised via heartbeat ("prefill"/"decode"); None =
+    #: mixed/unknown — eligible for every placement (observation-only
+    #: default). Set AND cleared by heartbeats, the authoritative carrier.
+    role: Optional[str] = None
 
 
 class FleetHealth:
@@ -94,6 +98,7 @@ class FleetHealth:
         self.heartbeats_seen = 0  # guarded_by: _mu
         self.publisher_drops_reported = 0  # guarded_by: _mu
         self.pods_drained = 0  # guarded_by: _mu
+        self.prefills_completed = 0  # guarded_by: _mu
         self._sweep_thread: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
 
@@ -145,18 +150,25 @@ class FleetHealth:
         return gap
 
     def observe_heartbeat(
-        self, pod: str, dropped_batches: int, draining: bool = False
+        self,
+        pod: str,
+        dropped_batches: int,
+        draining: bool = False,
+        role: Optional[str] = None,
     ) -> None:
         """A heartbeat proves liveness and reports the publisher's drop
         count; an increase means batches were lost even if no later seq
         ever reveals the gap. ``draining`` advertises a mid-drain pod —
         the scorer stops returning it immediately (set AND cleared here:
-        heartbeats are the authoritative carrier of drain intent)."""
+        heartbeats are the authoritative carrier of drain intent).
+        ``role`` advertises the pod's serving tier for the placement
+        filter; None (mixed/legacy heartbeats) clears it."""
         with self._mu:
             st = self._pods.setdefault(pod, _PodState())
             st.last_seen = self._clock()
             st.swept = False
             st.draining = draining
+            st.role = role if role in ("prefill", "decode") else None
             self.heartbeats_seen += 1
             if dropped_batches < st.reported_drops:
                 # Publisher restart: its drop counter restarted too. Rebase
@@ -204,6 +216,17 @@ class FleetHealth:
         collector.fleet_pods_drained.inc()
         log.warning("pod drained; evicted from routing immediately", pod=pod)
 
+    def observe_prefill_complete(self, pod: str) -> None:
+        """A ``PrefillComplete`` event: a prefill-role pod finished a
+        request's ingest and its chain is exportable — handoff supply for
+        disaggregated serving (counted; the chain's own BlockStored events
+        carry the locality)."""
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.last_seen = self._clock()
+            st.swept = False
+            self.prefills_completed += 1
+
     # -- read-side queries ---------------------------------------------------
     def is_expired(self, pod: str) -> bool:
         """True when the pod passed its TTL (or was swept, or said its
@@ -250,14 +273,65 @@ class FleetHealth:
                 return True
             return (self._clock() - st.last_seen) <= ttl
 
-    def filter_scores(self, scores: dict[str, int]) -> dict[str, int]:
+    def role_of(self, pod: str) -> Optional[str]:
+        """The pod's heartbeat-advertised role ("prefill"/"decode"), or
+        None for mixed/unknown pods."""
+        with self._mu:
+            st = self._pods.get(pod)
+            return st.role if st is not None else None
+
+    def filter_scores(
+        self, scores: dict[str, int], placement: Optional[str] = None
+    ) -> dict[str, int]:
         """Drop expired and draining pods from a score map — the guarantee
         that routing never targets a pod past its TTL (even before the
-        sweeper lands) nor one that advertised a drain in progress."""
+        sweeper lands) nor one that advertised a drain in progress.
+        ``placement`` ("prefill"/"decode"; None = legacy, role-blind)
+        additionally excludes pods whose advertised role cannot serve that
+        tier — a prefill-only pod must never win decode placement."""
         if not scores:
             return scores
-        out = {p: s for p, s in scores.items() if self.is_routable(p)}
+        if placement is None:
+            roles: dict[str, Optional[str]] = {}
+        else:
+            # One locked cut for every candidate's role (this runs per
+            # scoring request; a per-pod role_of() would double the lock
+            # churn is_routable already pays).
+            with self._mu:
+                roles = {
+                    p: (st.role if (st := self._pods.get(p)) else None)
+                    for p in scores
+                }
+        wrong_tier = "prefill" if placement == "decode" else "decode"
+        out = {
+            p: s
+            for p, s in scores.items()
+            if roles.get(p) != wrong_tier and self.is_routable(p)
+        }
         return out if len(out) != len(scores) else scores
+
+    def pod_views(self) -> dict[str, dict]:
+        """Planner-facing snapshot: per-pod role/draining/expired state in
+        one locked cut. This (with ``role_of``) is the HTTP-deployment
+        hook for assembling ``router.PodView``s from heartbeat state at a
+        scorer-embedded planner; the in-process coordinator builds its
+        views from live ``PodServer`` attributes instead
+        (``disagg.views_from_pods``)."""
+        ttl = self.config.pod_ttl_s
+        now = self._clock()
+        with self._mu:
+            return {
+                pod: {
+                    "role": st.role,
+                    "draining": st.draining or st.drained,
+                    "expired": bool(
+                        st.swept
+                        or st.drained
+                        or (ttl > 0 and (now - st.last_seen) > ttl)
+                    ),
+                }
+                for pod, st in self._pods.items()
+            }
 
     def snapshot(self) -> dict:
         """Counters + per-pod state for ``/stats``."""
@@ -269,6 +343,9 @@ class FleetHealth:
                     "draining": st.draining,
                     "drained": st.drained,
                     "age_s": round(self._clock() - st.last_seen, 3),
+                    # Role key only for role-advertising pods: a role-less
+                    # fleet's snapshot payload stays bit-identical legacy.
+                    **({"role": st.role} if st.role is not None else {}),
                 }
                 for pod, st in self._pods.items()
             }
@@ -283,6 +360,13 @@ class FleetHealth:
                 "heartbeats_seen": self.heartbeats_seen,
                 "publisher_drops_reported": self.publisher_drops_reported,
                 "pods_drained": self.pods_drained,
+                # Key appears only once disagg traffic exists: the no-knobs
+                # /stats payload keeps its legacy field set.
+                **(
+                    {"prefills_completed": self.prefills_completed}
+                    if self.prefills_completed
+                    else {}
+                ),
                 "pods": pods,
             }
 

@@ -27,6 +27,7 @@ from .events import (
     Heartbeat,
     IndexSnapshot,
     PodDrained,
+    PrefillComplete,
     decode_event_batch,
 )
 
@@ -232,8 +233,17 @@ class KVEventsPool:
             elif isinstance(ev, Heartbeat):
                 if self.health is not None:
                     self.health.observe_heartbeat(
-                        msg.pod_identifier, ev.dropped_batches, ev.draining
+                        msg.pod_identifier,
+                        ev.dropped_batches,
+                        ev.draining,
+                        role=ev.role,
                     )
+            elif isinstance(ev, PrefillComplete):
+                # Observation-only: the chain's BlockStored events already
+                # carry the locality truth; this just counts handoff supply
+                # (and liveness, via observe_message above).
+                if self.health is not None:
+                    self.health.observe_prefill_complete(msg.pod_identifier)
             elif isinstance(ev, IndexSnapshot):
                 self._apply_snapshot(msg, ev)
             elif isinstance(ev, PodDrained):

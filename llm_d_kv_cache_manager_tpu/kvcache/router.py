@@ -187,3 +187,164 @@ class BlendedRouter:
             pull_source=pull_source,
             pull_blocks=pull_blocks,
         )
+
+
+# -- disaggregated prefill/decode placement (ISSUE 9) ------------------------
+
+
+@dataclass
+class PodView:
+    """Planner-facing snapshot of one pod, assembled by the caller from
+    heartbeat state (role/draining, ``FleetHealth.pod_views``) and serving
+    telemetry (queue depth, measured prefill rate — the PR 3-4 heartbeat /
+    ``/stats`` carriers). A view is a point-in-time read; the planner
+    treats it as truth for one placement and re-plans on failure."""
+
+    name: str
+    #: "prefill" | "decode" | "mixed" (mixed serves either tier)
+    role: str = "mixed"
+    #: the pod's KV-transfer export endpoint (chain handoff source); None
+    #: = the pod cannot export, so it can never be a disagg prefill hop
+    transfer_endpoint: Optional[str] = None
+    draining: bool = False
+    #: crashed/expired/unreachable (TTL-expired per FleetHealth, engine
+    #: failed, or the caller observed a submit fail)
+    dead: bool = False
+    #: the pod's transfer plane is suspect: some peer's circuit breaker to
+    #: its export endpoint is OPEN — a pull through it would skip to cold
+    breaker_open: bool = False
+    #: outstanding requests (waiting + prefilling + running) — the decode
+    #: tier's ITL-headroom signal and the prefill tier's load tiebreak
+    queue_depth: float = 0.0
+    #: measured prefill tokens/s (the engine's online EMA); None = unknown
+    prefill_rate: Optional[float] = None
+
+
+@dataclass
+class DisaggPlan:
+    """A two-hop placement: run ingest on ``prefill_pod`` (stop at first
+    token), hand the chain to ``decode_pod`` over the transfer fabric,
+    stream tokens there. ``mode == "single"`` is the fallback — serve the
+    whole request on ``decode_pod`` exactly as today, so no failure mode
+    is worse than the non-disagg fleet."""
+
+    prefill_pod: Optional[str]
+    decode_pod: str
+    #: "disagg" (two hops) or "single" (legacy one-pod serving)
+    mode: str = "disagg"
+    #: why the planner fell back / what drove the pick (operator-facing)
+    reason: str = ""
+    #: the prefill pod's transfer endpoint the decode hop pulls from
+    pull_source: Optional[str] = None
+    #: index warmth at the prefill pick (observability)
+    prefill_score: int = 0
+
+
+class PlanError(RuntimeError):
+    """No healthy pod can serve the request (e.g. every decode-capable pod
+    is dead or draining) — the caller surfaces this as an overload-style
+    failure rather than silently queueing on a doomed pod."""
+
+
+class TwoHopPlanner:
+    """Placement for disaggregated prefill/decode serving.
+
+    The prefill hop goes where ingest finishes soonest: index warmth
+    first (a warm chain skips most of the prefill), then the measured
+    prefill rate, then the shortest queue. The decode hop goes where
+    streaming has the most ITL headroom: the shallowest queue among
+    decode-capable pods. Draining and dead pods are never picked;
+    breaker-open pods (pulls from their export endpoint skip to cold)
+    are excluded only from the prefill hop — they still serve decode and
+    single-pod traffic exactly as a legacy fleet would. ``exclude`` lets
+    the caller re-plan around a pod that just failed mid-handoff. When the two picks coincide (mixed pod), or no
+    prefill-capable exporter exists, the plan degrades to single-pod
+    serving — bit-identical to the legacy fleet's behavior.
+
+    ``score_fn(tokens, pod_names) -> {pod: score}`` is the same index
+    read path ``BlendedRouter`` uses (None = warmth-blind placement).
+    """
+
+    def __init__(self, score_fn: Optional[Callable] = None):
+        self.score_fn = score_fn
+
+    @staticmethod
+    def _usable(v: PodView) -> bool:
+        # breaker_open is deliberately NOT a liveness exclusion: it only
+        # means pulls FROM this pod's export endpoint skip to cold, so it
+        # disqualifies the pod as a prefill hop (below), never from decode
+        # or single-pod serving — legacy fleets serve fine with open
+        # breakers, and "no failure mode worse than today" must hold.
+        return not (v.dead or v.draining)
+
+    def plan(
+        self,
+        tokens: Sequence[int],
+        views: Sequence[PodView],
+        exclude: Optional[set] = None,
+    ) -> DisaggPlan:
+        exclude = exclude or set()
+        usable = [v for v in views if self._usable(v) and v.name not in exclude]
+        if not usable:
+            raise PlanError("no healthy pods to place on")
+        decode_tier = [v for v in usable if v.role in ("decode", "mixed")]
+        if not decode_tier:
+            # A prefill-only fleet cannot stream tokens for anyone: this is
+            # a deployment error, not a degradable state (docs/operations).
+            raise PlanError("no decode-capable pod (fleet is prefill-only)")
+        scores = (
+            self.score_fn(tokens, [v.name for v in usable])
+            if self.score_fn is not None
+            else {}
+        )
+        prefill_tier = [
+            v
+            for v in usable
+            if v.role in ("prefill", "mixed")
+            and v.transfer_endpoint
+            and not v.breaker_open
+        ]
+        # Decode pick: most ITL headroom = shallowest queue (deterministic
+        # name tiebreak so identical fleets plan identically).
+        decode = min(decode_tier, key=lambda v: (v.queue_depth, v.name))
+        if not prefill_tier:
+            # No exporter to run ingest on: single-pod serve at the warmth
+            # (falling back to headroom) among decode-capable pods.
+            best = max(
+                decode_tier,
+                key=lambda v: (scores.get(v.name, 0), -v.queue_depth, v.name),
+            )
+            return DisaggPlan(
+                prefill_pod=None,
+                decode_pod=best.name,
+                mode="single",
+                reason="no prefill-capable exporter",
+                prefill_score=scores.get(best.name, 0),
+            )
+        prefill = max(
+            prefill_tier,
+            key=lambda v: (
+                scores.get(v.name, 0),
+                v.prefill_rate or 0.0,
+                -v.queue_depth,
+                v.name,
+            ),
+        )
+        if prefill.name == decode.name:
+            # Both hops land on one (mixed) pod: a handoff to yourself is
+            # pure overhead — serve single-pod there, exactly as today.
+            return DisaggPlan(
+                prefill_pod=None,
+                decode_pod=decode.name,
+                mode="single",
+                reason="prefill and decode picks coincide",
+                prefill_score=scores.get(decode.name, 0),
+            )
+        return DisaggPlan(
+            prefill_pod=prefill.name,
+            decode_pod=decode.name,
+            mode="disagg",
+            reason="warmth+rate prefill pick, headroom decode pick",
+            pull_source=prefill.transfer_endpoint,
+            prefill_score=scores.get(prefill.name, 0),
+        )

@@ -48,6 +48,19 @@ from ..utils import get_logger, log_context
 log = get_logger("server.api")
 
 
+def _parse_placement(body: dict):
+    """The optional disagg ``placement`` field both scoring endpoints
+    accept: returns ``(placement, None)`` when valid ("prefill"/"decode"/
+    absent) or ``(None, 400-response)`` for anything else."""
+    placement = body.get("placement")
+    if placement not in (None, "prefill", "decode"):
+        return None, web.json_response(
+            {"error": "placement must be 'prefill' or 'decode' when set"},
+            status=400,
+        )
+    return placement, None
+
+
 @dataclass
 class ServiceConfig:
     http_port: int = 8080
@@ -200,8 +213,11 @@ class ScoringService:
                 status=400,
             )
         pods = body.get("pod_identifiers") or []
+        placement, bad = _parse_placement(body)
+        if bad is not None:
+            return bad
         headers, scores, degraded = await self._traced_score(
-            request, "/score_completions", prompt, model, pods
+            request, "/score_completions", prompt, model, pods, placement
         )
         if degraded is not None:
             return web.json_response(
@@ -210,7 +226,13 @@ class ScoringService:
         return web.json_response({"scores": scores}, headers=headers)
 
     async def _traced_score(
-        self, request: web.Request, endpoint: str, prompt: str, model: str, pods
+        self,
+        request: web.Request,
+        endpoint: str,
+        prompt: str,
+        model: str,
+        pods,
+        placement=None,
     ):
         """The one scoring path both endpoints share: trace mint-or-adopt
         (the scoring service is the fleet's front door, so the trace id
@@ -221,7 +243,9 @@ class ScoringService:
         backend failed: degrade to an empty scoreboard so the router falls
         back to a cold placement and the REQUEST still serves, just
         without cache affinity (a 500 here would turn an index outage
-        into a serving outage)."""
+        into a serving outage). ``placement`` ("prefill"/"decode"/None)
+        is the disagg tier being placed for — pods whose advertised role
+        cannot serve it are dropped from the scoreboard."""
         loop = asyncio.get_running_loop()
         span = self.tracer.start_span(
             "scorer.score",
@@ -241,7 +265,8 @@ class ScoringService:
             t0 = time.perf_counter()
             try:
                 scores = await loop.run_in_executor(
-                    None, self.indexer.get_pod_scores, prompt, model, pods
+                    None, self.indexer.get_pod_scores, prompt, model, pods,
+                    placement,
                 )
             except Exception as exc:
                 log.exception("scoring failed; degrading to empty scoreboard")
@@ -265,6 +290,11 @@ class ScoringService:
                 {"error": "fields 'messages' (list) and 'model' (str) are required"},
                 status=400,
             )
+        # Validate before the template render: an invalid placement is a
+        # guaranteed 400 and must not pay the fetch+render first.
+        placement, bad = _parse_placement(body)
+        if bad is not None:
+            return bad
         loop = asyncio.get_running_loop()
 
         def render():
@@ -299,7 +329,7 @@ class ScoringService:
             return web.json_response({"error": str(exc)}, status=400)
         headers, scores, degraded = await self._traced_score(
             request, "/score_chat_completions", prompt, model,
-            body.get("pod_identifiers") or [],
+            body.get("pod_identifiers") or [], placement,
         )
         if degraded is not None:
             # Index backend down: same degradation contract as

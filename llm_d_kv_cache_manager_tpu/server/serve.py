@@ -38,13 +38,14 @@ import uuid
 from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from ..kvcache.kvevents import (
     Heartbeat,
     IndexSnapshot,
     PodDrained,
+    PrefillComplete,
     ZMQPublisher,
     ZMQPublisherConfig,
 )
@@ -535,6 +536,15 @@ class PodServerConfig:
     #: the expected concurrent pull-routed admissions; see
     #: docs/operations.md.
     pull_workers: int = 2
+    #: disaggregated serving role (``POD_ROLE``): "mixed" (default) serves
+    #: prefill and decode exactly as today — bit-identical legacy behavior
+    #: and wire bytes. "prefill" runs ingest at full batch width and stops
+    #: at the first token (submits are clamped to one generated token; the
+    #: finished chain is exported over the transfer fabric and announced
+    #: with a ``PrefillComplete`` event). "decode" admits handed-off
+    #: requests (``pull_source``) and streams tokens; the scorer keeps it
+    #: out of prefill placement via the heartbeat role advertisement.
+    pod_role: str = "mixed"
     # -- fleet self-healing (all off by default = bit-identical legacy) ----
     #: seconds between Heartbeat events (liveness beacon + publisher drop
     #: report for the indexer's dead-pod sweep); 0 = no heartbeats.
@@ -604,6 +614,8 @@ class PodServerConfig:
         )
         cfg.async_pull = _env_bool("ASYNC_PULL", "0")
         cfg.pull_workers = int(os.environ.get("PULL_WORKERS", cfg.pull_workers))
+        # Disaggregated serving role (unset/"mixed" = legacy single-tier).
+        cfg.pod_role = os.environ.get("POD_ROLE", cfg.pod_role).strip() or "mixed"
         # Fleet self-healing (0/unset = off, legacy behavior).
         cfg.heartbeat_interval_s = float(
             os.environ.get("HEARTBEAT_INTERVAL_S", cfg.heartbeat_interval_s)
@@ -737,6 +749,11 @@ class PodServer:
         pod performs, prefill tokens/s from the engine's own online EMA —
         so the model's pull/cold branches can ever activate."""
         self.config = config or PodServerConfig()
+        if self.config.pod_role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"POD_ROLE must be mixed/prefill/decode, got "
+                f"{self.config.pod_role!r}"
+            )
         self._tokenizer = tokenizer
         self.transfer_cost_model = transfer_cost_model
         #: request tracing (OBS_TRACING); a disabled tracer hands out one
@@ -825,6 +842,12 @@ class PodServer:
         self.async_pulls = 0  # landed >= 1 block  # guarded_by: _mu|_work
         self.async_pull_fallbacks = 0  # -> cold prefill  # guarded_by: _mu|_work
         self.async_pull_canceled = 0  # seq died mid-fetch  # guarded_by: _mu|_work
+        # -- disaggregated serving (POD_ROLE; "mixed" = nothing below runs) --
+        #: prefill-role scheduler gate: submits whose max_new_tokens the
+        #: role clamped to one (ingest stops at the first token)
+        self.role_clamped_requests = 0  # guarded_by: _mu|_work
+        #: PrefillComplete events published (handoff supply)
+        self.prefill_completes_published = 0  # guarded_by: _mu|_work
 
         # -- fleet self-healing (heartbeats + periodic resync) --------------
         # Digest reads hop onto the engine loop like exports/imports: page
@@ -947,6 +970,45 @@ class PodServer:
         with self._mu:
             return self._draining
 
+    @property
+    def is_alive(self) -> bool:
+        """Running with a healthy engine — the planner's ``dead`` signal
+        (one locked read; the fleet view must not see a torn state)."""
+        with self._mu:
+            return self._running and self._failed is None
+
+    @property
+    def queue_depth(self) -> int:
+        """Outstanding work: staged + scheduler waiting/prefilling/running
+        — the decode tier's ITL-headroom signal for the two-hop planner.
+        len() snapshots of engine-owned lists, momentarily stale is fine
+        (same contract as admission's depth read)."""
+        sch = self.engine.scheduler
+        with self._mu:
+            staged = len(self._staging)
+        return staged + len(sch.waiting) + len(sch.prefilling) + len(sch.running)
+
+    @property
+    def prefill_rate(self) -> Optional[float]:
+        """Measured prefill tokens/s (the engine's online EMA; None until
+        the first prefill) — the planner's prefill-hop speed signal, the
+        same number heartbeats/`/stats` carry."""
+        return self.engine._prefill_rate
+
+    @property
+    def open_breaker_endpoints(self) -> set:
+        """Transfer endpoints this pod currently holds an OPEN circuit
+        breaker for — a pull through them would skip straight to cold.
+        The disagg planner view aggregates these across the fleet to keep
+        suspect exporters out of the prefill hop."""
+        with self._mu:
+            clients = dict(self._transfer_clients)
+        return {
+            endpoint
+            for endpoint, client in clients.items()
+            if client.breaker is not None and client.breaker.state == "open"
+        }
+
     def shutdown(self) -> None:
         self._self_heal_stop.set()
         if self._self_heal_thread is not None:
@@ -1037,6 +1099,29 @@ class PodServer:
         self.metrics.observe_finished(seq)
         if seq.trace_span is not None:
             self._emit_request_spans(seq)
+        if (
+            self.config.pod_role == "prefill"
+            and self._publisher is not None
+            and seq.finish_reason not in ("abort", "deadline")
+            and seq.num_generated >= 1
+        ):
+            # Trailing-append handoff announcement: the ingest finished and
+            # the chain is registered + exportable. Failures are swallowed
+            # like heartbeats — the serving-plane handoff (which carries
+            # the first token) does not depend on the event landing.
+            try:
+                self._publisher.publish(
+                    [
+                        PrefillComplete(
+                            request_id=seq.request_id or "",
+                            num_blocks=seq.num_registered_pages,
+                        )
+                    ]
+                )
+                with self._mu:
+                    self.prefill_completes_published += 1
+            except Exception:
+                log.exception("PrefillComplete publish failed")
         fut = self._futures.pop(seq.seq_id, None)
         if fut is not None:
             self._forget_pending(seq.user_prompt_len)
@@ -1305,6 +1390,13 @@ class PodServer:
                             self._publisher, "dropped_batches", 0
                         ),
                         draining=draining,
+                        # Role rides only on non-mixed pods: a mixed pod's
+                        # heartbeat bytes stay bit-identical legacy.
+                        role=(
+                            self.config.pod_role
+                            if self.config.pod_role != "mixed"
+                            else None
+                        ),
                     )
                 ]
             )
@@ -1724,6 +1816,18 @@ class PodServer:
         # add_request applies (the rest raise through the Future).
         if not prompt_tokens:
             raise ValueError("empty prompt")
+        clamped = False
+        if self.config.pod_role == "prefill":
+            # Role gate at admission: a prefill-tier pod runs ingest at
+            # full batch width and stops at the first token — the engine
+            # never dispatches a decode-only step because every sequence
+            # finishes at its prefill commit. The scheduler itself is
+            # untouched (its prefill-priority walk IS the gate's second
+            # half); decode work belongs on the decode tier.
+            sampling = sampling or SamplingParams()
+            if sampling.max_new_tokens > 1:
+                sampling = replace(sampling, max_new_tokens=1)
+                clamped = True
         if deadline_s is None and self.config.default_deadline_s > 0:
             deadline_s = self.config.default_deadline_s
         deadline = (
@@ -1749,6 +1853,8 @@ class PodServer:
                     "pod is draining; retry against another pod"
                 )
             self._check_admission(len(prompt_tokens))
+            if clamped:
+                self.role_clamped_requests += 1
             span = self.tracer.start_span(
                 "pod.request",
                 parent=trace_ctx,
@@ -2034,6 +2140,8 @@ class PodServer:
                 async_pulls = self.async_pulls
                 async_fallbacks = self.async_pull_fallbacks
                 async_canceled = self.async_pull_canceled
+                role_clamped = self.role_clamped_requests
+                prefill_completes = self.prefill_completes_published
             payload = {
                 "pod": self.config.pod_identifier,
                 "model": self.config.model_name,
@@ -2083,6 +2191,14 @@ class PodServer:
                     "forced_requests": drain_forced,
                 },
             }
+            if self.config.pod_role != "mixed":
+                # Disagg block only for role-assigned pods: the knobs-off
+                # /stats payload stays bit-identical.
+                payload["disagg"] = {
+                    "role": self.config.pod_role,
+                    "role_clamped_requests": role_clamped,
+                    "prefill_completes_published": prefill_completes,
+                }
             if self.config.async_pull:
                 # Async-import block only when the knob is on: the
                 # knobs-off /stats payload stays bit-identical.
