@@ -222,15 +222,17 @@ class Pod:
         from llm_d_kv_cache_manager_tpu.server.engine import Engine
 
         self.pod_id = pod_id
-        make_msg = publish(pod_id)
+        self._make_msg = publish(pod_id)
         self.bus = bus
         self._unstamped: list[object] = []
-        # Stage the message; step_timed stamps it with the post-step clock
-        # (events are flushed at the end of engine.step()).
+        # Stage the raw events; step_timed builds the wire message with the
+        # post-step clock as the batch's publish timestamp (events are
+        # flushed at the end of engine.step()), so the staleness probes see
+        # honest virtual publish times.
         self.engine = Engine(
             engine_cfg,
             params=params,
-            on_events=lambda events: self._unstamped.append(make_msg(events)),
+            on_events=lambda events: self._unstamped.append(list(events)),
         )
         self.engine.obs_step_timing = STEP_PHASES
         self.clock = 0.0
@@ -263,8 +265,8 @@ class Pod:
         self._step_samples.append(dt)
         self.clock += dt
         if self._unstamped:
-            for msg in self._unstamped:
-                self.bus.stage(msg, self.clock)
+            for events in self._unstamped:
+                self.bus.stage(self._make_msg(events, self.clock), self.clock)
             self._unstamped.clear()
         # Record first-token virtual times (running lanes catch prefill
         # first-tokens; `done` catches sequences that finished this step).
@@ -298,8 +300,12 @@ class Pod:
         raise RuntimeError("pod failed to drain")
 
 
-def make_event_pipeline(index, n_pods):
-    """Real write path: msgpack-encode batches, shard into the events pool."""
+def make_event_pipeline(index, n_pods, staleness=None, audit=None):
+    """Real write path: msgpack-encode batches, shard into the events pool.
+
+    ``staleness``/``audit`` (optional ``obs.audit`` trackers) attach the
+    ISSUE 10 probes to the same pool the product runs — the bench measures
+    the audit plane itself, not a stand-in."""
     from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
         KVEventsPool,
         KVEventsPoolConfig,
@@ -307,24 +313,64 @@ def make_event_pipeline(index, n_pods):
     from llm_d_kv_cache_manager_tpu.kvcache.kvevents.events import EventBatch
     from llm_d_kv_cache_manager_tpu.kvcache.kvevents.pool import Message
 
-    pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=min(4, n_pods)))
+    pool = KVEventsPool(
+        index,
+        KVEventsPoolConfig(concurrency=min(4, n_pods)),
+        staleness=staleness,
+        audit=audit,
+    )
     pool.start()
+
+    _seqs = {}
 
     def publish(pod_id):
         pod_name = f"tpu-pod-{pod_id}"
 
-        def make_msg(events):
-            batch = EventBatch(ts=0.0, events=list(events))
+        def make_msg(events, ts=0.0):
+            # Virtual publish timestamp + per-publisher seq: the staleness
+            # probes read both off the wire exactly as in production.
+            batch = EventBatch(ts=ts, events=list(events))
+            seq = _seqs.get(pod_name, 0)
+            _seqs[pod_name] = seq + 1
             return Message(
                 topic=f"kv@{pod_name}@{MODEL_NAME}",
                 pod_identifier=pod_name,
                 model_name=MODEL_NAME,
                 payload=batch.to_payload(),
+                seq=seq,
             )
 
         return make_msg
 
     return pool, publish
+
+
+def _audit_summary(auditor) -> dict:
+    """Fleet-level predicted-vs-realized columns from the joined audits:
+    the realized hit ratio (sum realized / sum predicted over decisions
+    that promised warmth) and the attributed miss mix."""
+    rows = auditor.recent(limit=1_000_000)
+    predicted = sum(r["predicted_blocks"] for r in rows)
+    realized = sum(
+        min(r["realized_blocks"], r["predicted_blocks"]) for r in rows
+    )
+    ratios = sorted(r["ratio"] for r in rows if r["ratio"] is not None)
+    snap = auditor.snapshot()
+    return {
+        "joined": snap["joined"],
+        "unmatched": snap["unmatched_realized"],
+        "predicted_blocks": predicted,
+        "realized_blocks": sum(r["realized_blocks"] for r in rows),
+        # Capped per-request (a request can't realize MORE than promised
+        # toward this ratio — overshoot is a different, happy story).
+        "realized_over_predicted": (
+            round(realized / predicted, 4) if predicted else None
+        ),
+        "ratio_p50": (
+            ratios[len(ratios) // 2] if ratios else None
+        ),
+        "misses": {k: v for k, v in snap["miss_causes"].items() if v},
+    }
 
 
 def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
@@ -341,7 +387,29 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     indexer = KVCacheIndexer(
         KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=page))
     )
-    pool, publish = make_event_pipeline(indexer.kv_block_index, n_pods)
+    # Routing-quality audit plane (ISSUE 10), on the PRODUCT trackers:
+    # staleness (publish→index-visibility on the virtual clock) and the
+    # predicted-vs-realized join are only meaningful for arms that consume
+    # the index — other policies never release events at decision time, so
+    # their lag would just measure the final drain.
+    staleness = auditor = None
+    vnow = [0.0]  # virtual "apply instant" the tracker's clock reads
+    if policy == "precise":
+        from llm_d_kv_cache_manager_tpu.obs.audit import (
+            RouteAuditor,
+            StalenessTracker,
+        )
+
+        staleness = StalenessTracker(clock=lambda: vnow[0])
+        auditor = RouteAuditor(
+            index=indexer.kv_block_index,
+            model_name=MODEL_NAME,
+            ring=len(workload) + 1,
+            pending_cap=len(workload) + 1,
+        )
+    pool, publish = make_event_pipeline(
+        indexer.kv_block_index, n_pods, staleness=staleness, audit=auditor
+    )
     lag_s = float(os.environ.get("BENCH_EVENT_LAG_MS", "2")) / 1000.0
     bus = LaggedEventBus(pool, lag_s)
     pods = [Pod(i, engine_cfg, params, publish, bus) for i in range(n_pods)]
@@ -385,6 +453,7 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
                 loads_fn=lambda names: [
                     pods[pod_names.index(nm)].load for nm in names
                 ],
+                auditor=auditor,
             )
 
     # Cross-pod KV transfer arm (BENCH_TRANSFER=1, precise only): the
@@ -420,13 +489,17 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
     ttfts: dict[int, float] = {}
     arrivals: dict[int, float] = {}
     segments: dict[int, int] = {}
+    rid_of: dict[int, str] = {}  # seq_id -> audit request id (precise)
     rr = 0
-    for t, seg, tokens in workload:
+    for req_i, (t, seg, tokens) in enumerate(workload):
         # Advance every pod to the arrival instant so the index reflects
         # fleet state at routing time, then drain in-flight events.
         for pod in pods:
             pod.advance_to(t, ttfts, arrivals)
         if policy == "precise":
+            # Events released now APPLY now on the virtual clock — the
+            # staleness tracker's "index visibility" instant.
+            vnow[0] = t
             # The index sees exactly the events a real deployment's
             # indexer would have by the arrival instant (publish + lag);
             # routing is THE PRODUCT PATH (kvcache/router.BlendedRouter:
@@ -444,7 +517,9 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
                     cost_model.seed_rates(
                         prefill_tokens_s=float(np.median(rates))
                     )
-            decision = blended.route(tokens, pod_names, now=t)
+            decision = blended.route(
+                tokens, pod_names, now=t, request_id=f"req-{req_i}"
+            )
             best = pod_names.index(decision.pod)
             if decision.action == "pull" and decision.pull_source is not None:
                 src = pods[pod_names.index(decision.pull_source)]
@@ -483,10 +558,26 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
         pod.seqs.append(seq)
         arrivals[seq.seq_id] = t
         segments[seq.seq_id] = seg
+        if auditor is not None:
+            rid_of[seq.seq_id] = f"req-{req_i}"
     for pod in pods:
         pod.drain(ttfts, arrivals)
+    if staleness is not None:
+        # Leftover events apply at the end of the run on the virtual clock.
+        vnow[0] = max(p.clock for p in pods)
     bus.flush_all()
     pool.drain(timeout=10.0)
+    if auditor is not None:
+        # Join the pods' ground truth (first-prefill cache hits, the same
+        # accounting the hit-rate headline uses) against every recorded
+        # decision — the predicted-vs-realized / miss-attribution columns.
+        for i, pod in enumerate(pods):
+            for seq in pod.seqs:
+                rid = rid_of.get(seq.seq_id)
+                if rid is None or seq.seq_id not in pod.hit_stats:
+                    continue
+                cached, _ = pod.hit_stats[seq.seq_id]
+                auditor.record_realized(rid, pod_names[i], cached // page)
     pool.shutdown()
     indexer.shutdown()
 
@@ -556,6 +647,25 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
         for p in pods:
             for key, val in p.engine.step_stats.items():
                 phase_detail[key] = round(phase_detail.get(key, 0) + val, 4)
+    # Routing-quality columns (ISSUE 10): event-plane staleness
+    # percentiles on the virtual clock, and the predicted-vs-realized
+    # audit join with miss attribution — the ground truth ROADMAP items
+    # 3 and 4 will be judged against.
+    staleness_detail = None
+    if staleness is not None:
+        pct = staleness.percentiles()
+        snap = staleness.snapshot()
+        staleness_detail = {
+            "events": snap["events_observed"],
+            "p50_ms": (
+                round(pct["p50"] * 1000, 3) if pct["p50"] is not None else None
+            ),
+            "p99_ms": (
+                round(pct["p99"] * 1000, 3) if pct["p99"] is not None else None
+            ),
+            "max_ms": round(snap["max_lag_s"] * 1000, 3),
+        }
+    audit_detail = _audit_summary(auditor) if auditor is not None else None
     # The Pod.on_events closure references the Pod (staging buffer), so
     # Pod <-> Engine is now a reference CYCLE: without an explicit collect,
     # each policy's engines (~GBs of donated KV pools on the chip) survive
@@ -594,6 +704,8 @@ def run_policy(policy, workload, params, engine_cfg, n_pods, max_new_tokens):
         **({"host": host_detail} if host_detail is not None else {}),
         **({"spec": spec_detail} if spec_detail is not None else {}),
         **({"phases": phase_detail} if phase_detail is not None else {}),
+        **({"staleness": staleness_detail} if staleness_detail is not None else {}),
+        **({"audit": audit_detail} if audit_detail is not None else {}),
     }
 
 
@@ -622,8 +734,23 @@ def run_disagg(
     indexer = KVCacheIndexer(
         KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=page))
     )
+    from llm_d_kv_cache_manager_tpu.obs.audit import (
+        RouteAuditor,
+        StalenessTracker,
+    )
+
+    vnow = [0.0]
+    staleness = StalenessTracker(clock=lambda: vnow[0])
+    auditor = RouteAuditor(
+        index=indexer.kv_block_index,
+        model_name=MODEL_NAME,
+        ring=len(workload) + 1,
+        pending_cap=len(workload) + 1,
+    )
     n_pods = n_prefill + n_decode
-    pool, publish = make_event_pipeline(indexer.kv_block_index, n_pods)
+    pool, publish = make_event_pipeline(
+        indexer.kv_block_index, n_pods, staleness=staleness, audit=auditor
+    )
     lag_s = float(os.environ.get("BENCH_EVENT_LAG_MS", "2")) / 1000.0
     bus = LaggedEventBus(pool, lag_s)
     pods = [Pod(i, engine_cfg, params, publish, bus) for i in range(n_pods)]
@@ -652,8 +779,14 @@ def run_disagg(
 
     ttfts: dict[int, float] = {}
     arrivals: dict[int, float] = {}
-    #: prefill-hop seq -> (prompt tokens, source pod, decode pod name)
+    #: prefill-hop seq -> (seq, prompt tokens, source pod, decode pod
+    #: name, audit request id)
     pending: dict[int, tuple] = {}
+    #: audit rid -> (tier pod object, seq_id) for the realized join; the
+    #: ingest entry is the prediction's subject, the decode entry feeds
+    #: the both-tier hit accounting.
+    ingest_of: dict[str, tuple] = {}
+    decode_of: dict[str, tuple] = {}
     handoff = {"count": 0, "blocks": 0, "transfer_s": 0.0, "replans": 0}
     cont_sampling = SamplingParams(max_new_tokens=max_new_tokens - 1)
 
@@ -663,7 +796,7 @@ def run_disagg(
         pod cannot admit before the chain existed, nor before its own
         clock, and it pays the measured export/import wall + link time)."""
         for sid in list(pending):
-            seq, tokens, src, dec_name = pending[sid]
+            seq, tokens, src, dec_name, rid = pending[sid]
             if not seq.is_finished():
                 continue
             del pending[sid]
@@ -681,32 +814,54 @@ def run_disagg(
                 tokens + seq.generated_tokens, cont_sampling
             )
             tgt.seqs.append(cont)
+            decode_of[rid] = (tgt, cont.seq_id)
             handoff["count"] += 1
             handoff["blocks"] += n_imp
             handoff["transfer_s"] += wall + link_s
 
-    for t, _seg, tokens in workload:
+    for req_i, (t, _seg, tokens) in enumerate(workload):
         for pod in pods:
             pod.advance_to(t, ttfts, arrivals)
         process_handoffs()
+        vnow[0] = t
         bus.release(t)
         plan = planner.plan(tokens, views())
         src = prefill_pods[plan.prefill_pod]
         dec_name = plan.decode_pod
+        rid = f"req-{req_i}"
+        # The planner's warmth claim for the ingest hop IS the prediction
+        # under audit; realized comes from the prefill pod's first-prefill
+        # hit accounting below.
+        auditor.record_decision(
+            rid,
+            chosen_pod=plan.prefill_pod,
+            predicted_blocks=plan.prefill_score,
+            index_blocks=plan.prefill_score,
+            scoreboard={plan.prefill_pod: plan.prefill_score},
+            decision="disagg",
+            chain_hashes=indexer.token_processor.prefix_hashes(tokens),
+        )
         if not src.engine.has_work:
             src.clock = max(src.clock, t)
         seq = src.engine.add_request(tokens, SamplingParams(max_new_tokens=1))
         src.seqs.append(seq)
         arrivals[seq.seq_id] = t
-        pending[seq.seq_id] = (seq, tokens, src, dec_name)
+        pending[seq.seq_id] = (seq, tokens, src, dec_name, rid)
+        ingest_of[rid] = (src, seq.seq_id)
     while True:
         for pod in pods:
             pod.drain(ttfts, arrivals)
         process_handoffs()
         if not pending and not any(p.engine.has_work for p in pods):
             break
+    vnow[0] = max(p.clock for p in pods)
     bus.flush_all()
     pool.drain(timeout=10.0)
+    for rid, (src, sid) in ingest_of.items():
+        if sid in src.hit_stats:
+            auditor.record_realized(
+                rid, f"tpu-pod-{src.pod_id}", src.hit_stats[sid][0] // page
+            )
     pool.shutdown()
     indexer.shutdown()
 
@@ -728,17 +883,29 @@ def run_disagg(
             and s.seq_id in p.finish_clock
         ]
     )
-    # Workload cache behavior is measured at the INGEST tier only: the
-    # decode pods' prompt+[t1] continuations cache-hit the just-imported
-    # chain by construction, so counting them would add a ~100%-hit entry
-    # per request and inflate the rate vs the mixed arms' definition
-    # (shared-prefix reuse at first prefill).
-    prompt_tokens = sum(
+    # Realized cache behavior, BOTH tiers via the audit path (the r08
+    # record counted the ingest tier only — a decode-hop handoff that
+    # failed to cache-hit its imported chain was invisible). The tiers
+    # answer different questions and are reported separately: the ingest
+    # rate is the workload's shared-prefix reuse (comparable to the mixed
+    # arms' definition), the decode rate is handoff efficiency (~1.0 when
+    # every imported chain is hit; a drop means the transfer fabric
+    # delivered chains the decode engine then recomputed). The headline
+    # `prefix_cache_hit_rate` is the combined both-tier number.
+    ingest_prompt = sum(
         n for p in prefill_pods.values() for _, n in p.hit_stats.values()
     )
-    cached_tokens = sum(
+    ingest_cached = sum(
         c for p in prefill_pods.values() for c, _ in p.hit_stats.values()
     )
+    decode_prompt = decode_cached = 0
+    for tgt, sid in decode_of.values():
+        if sid in tgt.hit_stats:
+            c, n = tgt.hit_stats[sid]
+            decode_cached += c
+            decode_prompt += n
+    prompt_tokens = ingest_prompt + decode_prompt
+    cached_tokens = ingest_cached + decode_cached
     out_tokens = sum(len(s.output_tokens) for p in pods for s in p.seqs)
     res = {
         "n_prefill": n_prefill,
@@ -755,10 +922,30 @@ def run_disagg(
         "prefix_cache_hit_rate": (
             float(cached_tokens / prompt_tokens) if prompt_tokens else 0.0
         ),
+        "ingest_hit_rate": (
+            float(ingest_cached / ingest_prompt) if ingest_prompt else 0.0
+        ),
+        "decode_hit_rate": (
+            float(decode_cached / decode_prompt) if decode_prompt else None
+        ),
         "makespan_s": float(makespan),
         "handoffs": handoff["count"],
         "handoff_blocks": handoff["blocks"],
         "handoff_transfer_s": round(handoff["transfer_s"], 3),
+        "staleness": {
+            "events": staleness.snapshot()["events_observed"],
+            "p50_ms": (
+                round(staleness.percentiles()["p50"] * 1000, 3)
+                if staleness.percentiles()["p50"] is not None
+                else None
+            ),
+            "p99_ms": (
+                round(staleness.percentiles()["p99"] * 1000, 3)
+                if staleness.percentiles()["p99"] is not None
+                else None
+            ),
+        },
+        "audit": _audit_summary(auditor),
     }
     pods.clear()
     gc.collect()
@@ -1113,6 +1300,12 @@ def main() -> int:
             pressure["p90_estimated_over_precise"] = round(
                 pe["p90_ttft_s"] / pp["p90_ttft_s"], 3
             )
+        if pp and "audit" in pp:
+            # The forced-eviction regime's audit columns: pool pressure
+            # makes pods evict between scoring and serving, so this is
+            # where the miss attribution proves itself.
+            pressure["audit_precise"] = pp["audit"]
+            pressure["staleness_precise"] = pp.get("staleness")
         ph = pressure_results.get("precise_host")
         if ph is not None:
             # The capacity headline (ISSUE 6): host tier + int8 KV spill
@@ -1169,6 +1362,21 @@ def main() -> int:
                         )
                     }
                     if precise
+                    else None
+                ),
+                # Routing-quality audit columns (ISSUE 10; precise arm):
+                # event-plane staleness percentiles + the realized share
+                # of predicted warmth with attributed misses.
+                "routing_audit": (
+                    {
+                        "staleness_p50_ms": precise["staleness"]["p50_ms"],
+                        "staleness_p99_ms": precise["staleness"]["p99_ms"],
+                        "realized_over_predicted": precise["audit"][
+                            "realized_over_predicted"
+                        ],
+                        "misses": precise["audit"]["misses"],
+                    }
+                    if precise and "audit" in precise and "staleness" in precise
                     else None
                 ),
                 "pressure": pressure,

@@ -8,6 +8,7 @@ from .events import (
     IndexSnapshot,
     PodDrained,
     PrefillComplete,
+    RequestAudit,
     decode_event_batch,
 )
 from .health import FleetHealth, FleetHealthConfig
@@ -25,6 +26,7 @@ __all__ = [
     "IndexSnapshot",
     "PodDrained",
     "PrefillComplete",
+    "RequestAudit",
     "decode_event_batch",
     "FleetHealth",
     "FleetHealthConfig",

@@ -45,6 +45,16 @@ Disaggregated serving extensions (ISSUE 9; on the wire only when
   event lets the fleet (and the bench/chaos harnesses) observe handoff
   supply without polling pods, and proves liveness like any message.
 
+Routing-quality observability extension (ISSUE 10; on the wire only when
+a pod sets ``OBS_AUDIT``, so default traffic stays bit-identical):
+
+- ``RequestAudit``: ``["RequestAudit", request_id, realized_blocks]`` —
+  the serving pod's ground truth for one finished request: how many
+  prompt blocks its prefix cache actually served. The indexer-side
+  ``RouteAuditor`` joins it with the decision's predicted matched-block
+  count into the predicted-vs-realized ratio, regret and miss-attribution
+  metrics. Observation-only on the index.
+
 Decoding is positional and tolerant: trailing optional fields may be absent
 (the reference's "legacy" variants, ``events.go:113-153``) and unknown extra
 fields are ignored — this subsumes the reference's arity-sniffing legacy
@@ -65,6 +75,7 @@ HEARTBEAT_TAG = "Heartbeat"
 INDEX_SNAPSHOT_TAG = "IndexSnapshot"
 POD_DRAINED_TAG = "PodDrained"
 PREFILL_COMPLETE_TAG = "PrefillComplete"
+REQUEST_AUDIT_TAG = "RequestAudit"
 
 #: roles a pod may advertise (anything else decodes to None = mixed)
 POD_ROLES = ("prefill", "decode", "mixed")
@@ -166,6 +177,22 @@ class PrefillComplete:
         return [PREFILL_COMPLETE_TAG, self.request_id, self.num_blocks]
 
 
+@dataclass
+class RequestAudit:
+    """The serving pod's realized prefix-cache hit count for one finished
+    request — the ground-truth half of the routing audit (the scorer-side
+    ``RouteAuditor`` holds the predicted half, keyed by request id).
+    Observation-only on the index; published only by ``OBS_AUDIT`` pods —
+    absent from all default wire traffic."""
+
+    request_id: str = ""
+    #: prompt blocks served from this pod's prefix cache at first prefill
+    realized_blocks: int = 0
+
+    def to_tagged_union(self) -> list[Any]:
+        return [REQUEST_AUDIT_TAG, self.request_id, self.realized_blocks]
+
+
 Event = Union[
     BlockStored,
     BlockRemoved,
@@ -174,6 +201,7 @@ Event = Union[
     IndexSnapshot,
     PodDrained,
     PrefillComplete,
+    RequestAudit,
 ]
 
 
@@ -274,6 +302,16 @@ def _decode_event(raw) -> Optional[Event]:
         if not isinstance(n, int) or isinstance(n, bool):
             n = 0
         return PrefillComplete(request_id=rid, num_blocks=n)
+    if tag == REQUEST_AUDIT_TAG:
+        rid = _get(fields, 0, "")
+        if isinstance(rid, bytes):
+            rid = rid.decode("utf-8", "replace")
+        if not isinstance(rid, str):
+            rid = ""
+        n = _get(fields, 1, 0)
+        if not isinstance(n, int) or isinstance(n, bool):
+            n = 0
+        return RequestAudit(request_id=rid, realized_blocks=n)
     return None  # unknown tag
 
 

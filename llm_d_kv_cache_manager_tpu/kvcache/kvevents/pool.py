@@ -28,6 +28,7 @@ from .events import (
     IndexSnapshot,
     PodDrained,
     PrefillComplete,
+    RequestAudit,
     decode_event_batch,
 )
 
@@ -76,8 +77,11 @@ class KVEventsPool:
 
     ``health`` (optional, a ``FleetHealth``) receives per-message stream
     observations — last-seen seq per (pod, model) for gap detection,
-    heartbeats, resync acknowledgements. ``None`` (default) keeps the
-    legacy behavior bit-identical.
+    heartbeats, resync acknowledgements. ``staleness`` (optional, an
+    ``obs.StalenessTracker``) records publish→apply lag per (pod, event
+    type) plus received/applied seq high-waters; ``audit`` (optional, an
+    ``obs.RouteAuditor``) receives ``RequestAudit`` realized-hit reports.
+    All ``None`` (default) keeps the legacy behavior bit-identical.
     """
 
     def __init__(
@@ -85,12 +89,17 @@ class KVEventsPool:
         index: Index,
         config: Optional[KVEventsPoolConfig] = None,
         health: Optional["FleetHealth"] = None,
+        *,
+        staleness=None,
+        audit=None,
     ):
         self.config = config or KVEventsPoolConfig()
         if self.config.concurrency < 1:
             raise ValueError("concurrency must be >= 1")
         self.index = index
         self.health = health
+        self.staleness = staleness
+        self.audit = audit
         self._mu = threading.Lock()
         #: tasks rejected because the pool was already shut down — after the
         #: poison pill a task would sit unprocessed forever, which is worse
@@ -157,6 +166,13 @@ class KVEventsPool:
                 self.rejected_after_shutdown += 1
             else:
                 self._queues[shard].put(msg)
+                if self.staleness is not None:
+                    # Received high-water BEFORE the worker applies it: the
+                    # delta to the applied high-water is the events-behind
+                    # gauge (only admitted tasks count — a rejected task
+                    # will never be applied, so counting it would pin the
+                    # gauge above zero forever).
+                    self.staleness.observe_received(msg.pod_identifier, msg.seq)
                 return
         log.warning("event after pool shutdown; dropping", pod=msg.pod_identifier)
 
@@ -265,10 +281,29 @@ class KVEventsPool:
                 log.info(
                     "pod drained; evicted from index", pod=msg.pod_identifier
                 )
+            elif isinstance(ev, RequestAudit):
+                # Observation-only: the pod's realized prefix-cache hit
+                # count joins the scorer's prediction in the route auditor
+                # (predicted-vs-realized ratio + miss attribution).
+                if self.audit is not None:
+                    self.audit.record_realized(
+                        ev.request_id, msg.pod_identifier, ev.realized_blocks
+                    )
             elif isinstance(ev, AllBlocksCleared):
                 # No-op, as in the reference (pool.go:300-301): the event
                 # carries no hash list, and the index ages entries out.
                 continue
+
+        if self.staleness is not None:
+            # AFTER the apply loop: the lag measured is publish → index
+            # VISIBILITY (what a routing decision at this instant would
+            # see), not publish → dequeue.
+            self.staleness.observe_batch(
+                msg.pod_identifier,
+                msg.seq,
+                batch.ts,
+                [type(ev).__name__ for ev in batch.events],
+            )
 
     def _apply_snapshot(self, msg: Message, ev: IndexSnapshot) -> None:
         """Replace-all-for-pod reconciliation: the digest IS the pod's KV

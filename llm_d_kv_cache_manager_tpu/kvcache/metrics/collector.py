@@ -73,6 +73,18 @@ route_decisions = _NullMetric()
 score_latency = _NullMetric()
 index_blocks = _NullMetric()
 index_pods = _NullMetric()
+# Routing-quality observability (PR 10): event-plane staleness (publish →
+# index-visibility lag per pod/event type, events-behind per pod), the
+# predicted-vs-realized audit loop (hit ratio, per-decision regret, miss
+# attribution), and the scoreboard-size gauge. Series appear only when the
+# OBS_AUDIT/OBS_METRICS surfaces feed them — a knobs-off process never
+# touches a label.
+index_staleness = _NullMetric()
+index_events_behind = _NullMetric()
+scoreboard_size = _NullMetric()
+route_pvr = _NullMetric()
+route_regret = _NullMetric()
+route_miss = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -108,6 +120,8 @@ def register(registry=None) -> None:
     global fleet_gaps, fleet_resyncs, fleet_pods_swept, fleet_publisher_drops
     global breaker_opens, breaker_closes, fleet_pods_drained, scorer_errors
     global route_decisions, score_latency, index_blocks, index_pods
+    global index_staleness, index_events_behind, scoreboard_size
+    global route_pvr, route_regret, route_miss
     with _lock:
         if _registered:
             return
@@ -208,6 +222,56 @@ def register(registry=None) -> None:
             "(refreshed on /stats and /metrics scrapes)",
             registry=registry,
         )
+        index_staleness = _prom.Histogram(
+            "kvcache_index_staleness_seconds",
+            "Event-plane lag: publish timestamp to index application, per "
+            "pod and event type (OBS_AUDIT)",
+            ["pod", "event"],
+            registry=registry,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+        )
+        index_events_behind = _prom.Gauge(
+            "kvcache_index_events_behind",
+            "Events received from a pod's publisher but not yet applied "
+            "to the index (subscriber seq high-water minus worker "
+            "high-water; refreshed on /stats and /metrics scrapes)",
+            ["pod"],
+            registry=registry,
+        )
+        scoreboard_size = _prom.Gauge(
+            "kvcache_scorer_scoreboard_size",
+            "Pods in the most recent scoring response's scoreboard "
+            "(OBS_METRICS)",
+            registry=registry,
+        )
+        route_pvr = _prom.Histogram(
+            "kvcache_route_predicted_vs_realized_blocks",
+            "Realized prefix-cache hit blocks over the scorer's predicted "
+            "matched blocks, per audited request (1.0 = the prediction "
+            "held exactly; OBS_AUDIT)",
+            registry=registry,
+            buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0, 1.25,
+                     1.5, 2.0),
+        )
+        route_regret = _prom.Histogram(
+            "kvcache_route_regret_blocks",
+            "Per-decision counterfactual regret: best scoreboard entry "
+            "minus the chosen pod's score, in blocks (0 = the warmest pod "
+            "was picked), labeled by routing decision (OBS_AUDIT)",
+            ["decision"],
+            registry=registry,
+            buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                     256.0),
+        )
+        route_miss = _prom.Counter(
+            "kvcache_route_miss_attributed_total",
+            "Audited requests whose realized hits fell short of the "
+            "prediction, by attributed cause (stale_index / evicted_on_pod "
+            "/ never_stored / dead_pod_reroute; OBS_AUDIT)",
+            ["cause"],
+            registry=registry,
+        )
         _registered = True
 
 
@@ -215,6 +279,35 @@ def observe_route_decision(action: str) -> None:
     """One blended-router verdict (route_warm / pull / cold)."""
     bump(f"route_decisions_{action}")
     route_decisions.labels(decision=action).inc()
+
+
+def observe_staleness(pod: str, event: str, lag_s: float) -> None:
+    """One event's publish→index-application lag (OBS_AUDIT)."""
+    bump("staleness_events")
+    index_staleness.labels(pod=pod, event=event).observe(lag_s)
+
+
+def set_events_behind(pod: str, behind: int) -> None:
+    index_events_behind.labels(pod=pod).set(behind)
+
+
+def set_scoreboard_size(n: int) -> None:
+    scoreboard_size.set(n)
+
+
+def observe_predicted_vs_realized(ratio: float) -> None:
+    """Realized/predicted blocks for one audited request (OBS_AUDIT)."""
+    bump("route_audits_joined")
+    route_pvr.observe(ratio)
+
+
+def observe_route_regret(decision: str, regret_blocks: int) -> None:
+    route_regret.labels(decision=decision).observe(regret_blocks)
+
+
+def observe_miss_cause(cause: str) -> None:
+    bump(f"route_miss_{cause}")
+    route_miss.labels(cause=cause).inc()
 
 
 def set_index_size(blocks: int, pods: int) -> None:

@@ -131,14 +131,26 @@ class BlendedRouter:
         affinity: PrefixAffinityTracker,
         loads_fn: Callable[[Sequence[str]], Sequence[float]],
         cost_model=None,
+        auditor=None,
     ):
+        """``auditor`` (optional, an ``obs.RouteAuditor``): records each
+        decision's predicted matched-block count + scoreboard keyed by
+        request id, so the pod's realized prefix-cache hits can be joined
+        back into the predicted-vs-realized / regret / miss-attribution
+        metrics. None (default) records nothing — legacy behavior."""
         self.score_fn = score_fn
         self.affinity = affinity
         self.loads_fn = loads_fn
         self.cost_model = cost_model
+        self.auditor = auditor
 
     def route(
-        self, tokens: Sequence[int], pods: Sequence[str], now: float = 0.0
+        self,
+        tokens: Sequence[int],
+        pods: Sequence[str],
+        now: float = 0.0,
+        request_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> RoutingDecision:
         scores = self.score_fn(tokens, pods)
         keys = self.affinity.keys(tokens)
@@ -177,6 +189,37 @@ class BlendedRouter:
         collector.observe_route_decision(
             "cold" if action == "route_warm" and warm_blocks == 0 else action
         )
+        if self.auditor is not None and request_id is not None:
+            # Predicted = what this router believed the target would serve
+            # from cache: the index's claim when it has one, else the
+            # affinity model's (index_blocks=0 then marks the prediction
+            # as index-free — the `never_stored` discriminator). A pull
+            # decision promises the SOURCE's warm chain lands on the
+            # target before prefill, so its prediction is pull_blocks —
+            # recording the cold target's own score (~0) would drop every
+            # pull from the ratio histogram and leave a failed pull
+            # (dead peer, cold fallback) with nothing to attribute.
+            index_blocks = scores.get(pods[target], 0)
+            if action == "pull":
+                predicted = pull_blocks
+            elif index_blocks > 0:
+                predicted = index_blocks
+            else:
+                predicted = aff_scores[target]
+            self.auditor.record_decision(
+                request_id,
+                chosen_pod=pods[target],
+                predicted_blocks=predicted,
+                index_blocks=index_blocks,
+                scoreboard=scores,
+                decision=(
+                    "cold"
+                    if action == "route_warm" and warm_blocks == 0
+                    else action
+                ),
+                chain_hashes=keys,
+                trace_id=trace_id,
+            )
         # Decision metadata is DECISION-time state (what drove the pick),
         # captured before record() refreshes the affinity memory.
         return RoutingDecision(

@@ -7,6 +7,19 @@ the scoring/serving APIs, a trailing optional field in the KV-transfer
 msgpack envelope), so one request's time is attributable end to end.
 """
 
+from .audit import (  # noqa: F401
+    AuditRecord,
+    RouteAuditor,
+    StalenessTracker,
+    debug_audit_payload,
+    debug_staleness_payload,
+)
+from .slo import (  # noqa: F401
+    SLObjective,
+    SLORecorder,
+    parse_slo_spec,
+    parse_windows,
+)
 from .tracing import (  # noqa: F401
     NOOP_SPAN,
     Span,

@@ -1,0 +1,493 @@
+"""Routing-quality observability: index staleness + predicted-vs-realized.
+
+The system's value proposition rests on two claims nothing measured until
+this module existed: the global block index is *fresh enough* (KV events
+become index-visible fast enough that scores reflect reality), and the
+scorer's longest-prefix prediction is *accurate enough* (the pod really
+serves the cache hits the scoreboard promised). Two trackers close the
+loop, both off by default (``OBS_AUDIT``) with bit-identical legacy
+behavior when unattached:
+
+- ``StalenessTracker`` — event-plane lag. Every ``EventBatch`` carries its
+  publish timestamp; on ingest the tracker records publish→apply lag per
+  (pod, event type) (``kvcache_index_staleness_seconds``) and, from the
+  subscriber's per-publisher seq numbers, how many events each pod's
+  stream is behind (received-but-not-applied,
+  ``kvcache_index_events_behind``).
+- ``RouteAuditor`` — prediction vs reality. The router records each
+  decision's predicted matched-block count and scoreboard keyed by
+  request id; the pod reports the realized prefix-cache hit count back (a
+  trailing-append ``RequestAudit`` KV event, or a direct call in-process).
+  The join yields the realized/predicted ratio histogram
+  (``kvcache_route_predicted_vs_realized_blocks``), a per-decision regret
+  counterfactual (best scoreboard entry minus chosen,
+  ``kvcache_route_regret_blocks``), and — when realized < predicted — a
+  miss attribution (``kvcache_route_miss_attributed_total{cause}``):
+
+  * ``dead_pod_reroute`` — the request landed on (or the fleet now
+    considers) a different/unroutable pod than the one scored;
+  * ``never_stored``    — the index never claimed the chain on that pod
+    (the prediction came from affinity memory, not stored blocks);
+  * ``stale_index``     — the scored entries are gone from the index now:
+    the blocks were evicted after scoring and the prediction aged out;
+  * ``evicted_on_pod``  — the index still claims the blocks but the pod's
+    ground truth disagrees: the pod evicted them locally and the index
+    has not caught up (phantom locality, repaired by events/resync).
+
+Wall clock on purpose throughout: event publish timestamps cross the wire
+and are compared across hosts, so the comparison clock must be the same
+wall clock (injectable for tests and the bench's virtual clocks).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from ..kvcache.metrics import collector
+from ..utils import get_logger
+
+log = get_logger("obs.audit")
+
+#: shared histogram bucket upper bounds for staleness seconds (the last
+#: implicit bucket is +Inf) — ZMQ-hop lag is ms-scale when healthy,
+#: seconds-scale when the ingest pool is drowning.
+STALENESS_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+MISS_CAUSES = (
+    "stale_index",
+    "evicted_on_pod",
+    "never_stored",
+    "dead_pod_reroute",
+)
+
+
+def _percentile(samples: Sequence[float], q: float) -> Optional[float]:
+    if not samples:
+        return None
+    s = sorted(samples)
+    idx = min(int(q * len(s)), len(s) - 1)
+    return s[idx]
+
+
+class _LagHist:
+    """Fixed-bucket histogram + count/sum/max (one per (pod, event))."""
+
+    __slots__ = ("counts", "count", "sum", "max")
+
+    def __init__(self):
+        self.counts = [0] * (len(STALENESS_BUCKETS) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        for i, ub in enumerate(STALENESS_BUCKETS):
+            if v <= ub:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.count += 1
+        self.sum += v
+        self.max = max(self.max, v)
+
+
+class StalenessTracker:
+    """Publish→index-visibility lag + events-behind, per pod.
+
+    Attached to a ``KVEventsPool``: ``observe_received`` runs at enqueue
+    (the subscriber-facing edge), ``observe_batch`` when a worker applies
+    the batch. Unattached (the default) the pool touches nothing here.
+    ``clock`` must be the same wall clock the publishers stamp batches
+    with (``time.time`` in production; the bench injects its virtual
+    clock).
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.time,
+        max_samples: int = 8192,
+    ):
+        self._clock = clock
+        self._mu = threading.Lock()
+        #: (pod, event_tag) -> _LagHist
+        self._hists: dict[tuple[str, str], _LagHist] = {}  # guarded_by: _mu
+        #: recent lag samples (bounded) for percentile summaries
+        self._samples: deque = deque(maxlen=max_samples)  # guarded_by: _mu
+        self._received: dict[str, int] = {}  # pod -> last seq enqueued  # guarded_by: _mu
+        self._applied: dict[str, int] = {}  # pod -> last seq applied  # guarded_by: _mu
+        self.events_observed = 0  # guarded_by: _mu
+        self.max_lag_s = 0.0  # guarded_by: _mu
+
+    # -- pool-side observations ---------------------------------------------
+    def observe_received(self, pod: str, seq: int) -> None:
+        with self._mu:
+            prev = self._received.get(pod)
+            if prev is None:
+                # Seed the applied high-water one below the first seq seen,
+                # so enqueued-but-never-applied batches read as behind from
+                # the start — a cold-start backlog (subscriber enqueuing a
+                # storm the shard worker hasn't touched) must not read 0.
+                self._applied.setdefault(pod, seq - 1)
+            if prev is None or seq > prev:
+                self._received[pod] = seq
+
+    def observe_batch(
+        self, pod: str, seq: int, publish_ts: float, event_tags: Sequence[str]
+    ) -> None:
+        """One decoded batch applied to the index: record publish→apply
+        lag once per contained event, labeled by event type. ``ts <= 0``
+        (legacy publishers that stamp nothing) records nothing — a bogus
+        epoch delta would bury every real sample."""
+        lag = self._clock() - publish_ts if publish_ts > 0 else None
+        with self._mu:
+            prev = self._applied.get(pod)
+            if prev is None or seq > prev:
+                self._applied[pod] = seq
+            if lag is None:
+                return
+            lag = max(lag, 0.0)
+            for tag in event_tags:
+                self._hists.setdefault((pod, tag), _LagHist()).observe(lag)
+                self.events_observed += 1
+            self._samples.append(lag)
+            self.max_lag_s = max(self.max_lag_s, lag)
+        for tag in event_tags:
+            collector.observe_staleness(pod, tag, lag)
+
+    # -- read side -----------------------------------------------------------
+    def events_behind(self) -> dict[str, int]:
+        """Per pod: events enqueued but not yet applied (subscriber seq
+        high-water minus worker high-water). Mirrored into the
+        ``kvcache_index_events_behind`` gauge by the caller's scrape."""
+        with self._mu:
+            out = {
+                pod: max(seq - self._applied.get(pod, seq), 0)
+                for pod, seq in self._received.items()
+            }
+        for pod, behind in out.items():
+            collector.set_events_behind(pod, behind)
+        return out
+
+    def percentiles(self, qs=(0.5, 0.99)) -> dict[str, Optional[float]]:
+        with self._mu:
+            samples = list(self._samples)
+        return {f"p{int(q * 100)}": _percentile(samples, q) for q in qs}
+
+    def snapshot(self) -> dict:
+        """Compact summary for ``/stats``."""
+        with self._mu:
+            events = self.events_observed
+            max_lag = self.max_lag_s
+            samples = list(self._samples)
+        return {
+            "events_observed": events,
+            "max_lag_s": round(max_lag, 6),
+            "p50_lag_s": _percentile(samples, 0.5),
+            "p99_lag_s": _percentile(samples, 0.99),
+            "events_behind": self.events_behind(),
+        }
+
+    def detail(self) -> dict:
+        """Full per-(pod, event) histograms for ``/debug/staleness``."""
+        with self._mu:
+            per = {
+                f"{pod}/{tag}": {
+                    "count": h.count,
+                    "sum_s": round(h.sum, 6),
+                    "max_s": round(h.max, 6),
+                    "buckets": dict(
+                        zip([str(b) for b in STALENESS_BUCKETS] + ["+Inf"], h.counts)
+                    ),
+                }
+                for (pod, tag), h in self._hists.items()
+            }
+        return {
+            "bucket_bounds_s": list(STALENESS_BUCKETS),
+            "per_pod_event": per,
+            **self.snapshot(),
+        }
+
+
+@dataclass
+class AuditRecord:
+    """One joined decision/outcome pair (the ``/debug/audit`` row)."""
+
+    request_id: str
+    chosen_pod: str
+    realized_pod: str
+    predicted_blocks: int
+    realized_blocks: int
+    decision: str
+    regret_blocks: int
+    #: realized/predicted; None when predicted == 0 (nothing promised)
+    ratio: Optional[float]
+    #: miss attribution; None when realized >= predicted
+    cause: Optional[str]
+    trace_id: Optional[str] = None
+    #: wall-clock timestamps (decision / join) — display only
+    decided_at: float = 0.0
+    joined_at: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "chosen_pod": self.chosen_pod,
+            "realized_pod": self.realized_pod,
+            "predicted_blocks": self.predicted_blocks,
+            "realized_blocks": self.realized_blocks,
+            "decision": self.decision,
+            "regret_blocks": self.regret_blocks,
+            "ratio": self.ratio,
+            "cause": self.cause,
+            "trace_id": self.trace_id,
+            "decided_at": self.decided_at,
+            "joined_at": self.joined_at,
+        }
+
+
+@dataclass
+class _Pending:
+    chosen_pod: str
+    predicted_blocks: int
+    #: the index's own claim at decision time (0 = prediction came from
+    #: affinity memory — the ``never_stored`` discriminator)
+    index_blocks: int
+    scoreboard: dict
+    decision: str
+    regret_blocks: int
+    chain_hashes: tuple
+    model: str
+    trace_id: Optional[str]
+    decided_at: float
+
+
+class RouteAuditor:
+    """Joins routing decisions with realized prefix-cache hits.
+
+    ``index``/``fleet_health`` (both optional) power the miss attribution:
+    the index is re-probed at join time for the chain the decision scored,
+    and fleet health answers "was the pod even routable". Without them the
+    attribution degrades gracefully (every eviction-flavored miss reads
+    ``stale_index``).
+    """
+
+    def __init__(
+        self,
+        index=None,
+        fleet_health=None,
+        model_name: str = "",
+        ring: int = 2048,
+        pending_cap: int = 4096,
+        max_chain_hashes: int = 512,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.index = index
+        self.fleet_health = fleet_health
+        self.model_name = model_name
+        self.max_chain_hashes = max_chain_hashes
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._pending: "OrderedDict[str, _Pending]" = OrderedDict()  # guarded_by: _mu
+        self._pending_cap = pending_cap
+        self._ring: deque = deque(maxlen=max(ring, 1))  # guarded_by: _mu
+        self.decisions_recorded = 0  # guarded_by: _mu
+        self.joined = 0  # guarded_by: _mu
+        self.unmatched_realized = 0  # guarded_by: _mu
+        self.pending_evicted = 0  # guarded_by: _mu
+        self.miss_causes = dict.fromkeys(MISS_CAUSES, 0)  # guarded_by: _mu
+
+    # -- decision side (router/scorer) ---------------------------------------
+    def record_decision(
+        self,
+        request_id: str,
+        *,
+        chosen_pod: str,
+        predicted_blocks: int,
+        scoreboard: Optional[dict] = None,
+        index_blocks: Optional[int] = None,
+        decision: str = "route_warm",
+        chain_hashes: Sequence[int] = (),
+        model: Optional[str] = None,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        """Record what the scorer promised for ``request_id``. ``scoreboard``
+        is the top-k pod→score map the decision saw; regret = the best
+        entry minus the chosen entry (how much warmth the placement left
+        on the table, 0 when the warmest pod was picked)."""
+        scoreboard = dict(scoreboard or {})
+        best = max(scoreboard.values(), default=0)
+        regret = max(best - scoreboard.get(chosen_pod, 0), 0)
+        rec = _Pending(
+            chosen_pod=chosen_pod,
+            predicted_blocks=int(predicted_blocks),
+            index_blocks=(
+                int(index_blocks)
+                if index_blocks is not None
+                else int(predicted_blocks)
+            ),
+            scoreboard=scoreboard,
+            decision=decision,
+            regret_blocks=regret,
+            chain_hashes=tuple(chain_hashes)[: self.max_chain_hashes],
+            model=model if model is not None else self.model_name,
+            trace_id=trace_id,
+            decided_at=self._clock(),
+        )
+        with self._mu:
+            self._pending[request_id] = rec
+            self._pending.move_to_end(request_id)
+            self.decisions_recorded += 1
+            while len(self._pending) > self._pending_cap:
+                self._pending.popitem(last=False)
+                self.pending_evicted += 1
+        collector.observe_route_regret(decision, regret)
+
+    # -- realized side (pod report via RequestAudit event or in-process) ----
+    def record_realized(
+        self, request_id: str, pod: str, realized_blocks: int
+    ) -> Optional[AuditRecord]:
+        """Join the pod's ground truth with the pending decision. Returns
+        the joined record (also ring-buffered for ``/debug/audit``), or
+        None when no decision was recorded for this request id."""
+        with self._mu:
+            rec = self._pending.pop(request_id, None)
+            if rec is None:
+                self.unmatched_realized += 1
+                return None
+        realized_blocks = int(realized_blocks)
+        predicted = rec.predicted_blocks
+        ratio = (realized_blocks / predicted) if predicted > 0 else None
+        cause = None
+        if predicted > 0 and realized_blocks < predicted:
+            cause = self._attribute(rec, pod)
+            collector.observe_miss_cause(cause)
+        if ratio is not None:
+            collector.observe_predicted_vs_realized(ratio)
+        audit = AuditRecord(
+            request_id=request_id,
+            chosen_pod=rec.chosen_pod,
+            realized_pod=pod,
+            predicted_blocks=predicted,
+            realized_blocks=realized_blocks,
+            decision=rec.decision,
+            regret_blocks=rec.regret_blocks,
+            ratio=round(ratio, 4) if ratio is not None else None,
+            cause=cause,
+            trace_id=rec.trace_id,
+            decided_at=rec.decided_at,
+            joined_at=self._clock(),
+        )
+        with self._mu:
+            self.joined += 1
+            if cause is not None:
+                self.miss_causes[cause] += 1
+            self._ring.append(audit)
+        return audit
+
+    def _attribute(self, rec: _Pending, realized_pod: str) -> str:
+        """Classify one miss using current index + fleet-health state (see
+        the module docstring for the four causes)."""
+        fh = self.fleet_health
+        if realized_pod != rec.chosen_pod or (
+            fh is not None and not fh.is_routable(rec.chosen_pod)
+        ):
+            return "dead_pod_reroute"
+        if rec.index_blocks <= 0:
+            # The index never claimed the chain on this pod — the
+            # prediction came from affinity memory (or a wiped index).
+            return "never_stored"
+        current = self._probe(rec)
+        if current is None or current < rec.index_blocks:
+            # The scored entries are gone from the index too: evicted
+            # after scoring — the prediction was honest when made.
+            return "stale_index"
+        # The index STILL advertises the blocks the pod says it lacks:
+        # the pod evicted locally and the index has not caught up.
+        return "evicted_on_pod"
+
+    def _probe(self, rec: _Pending) -> Optional[int]:
+        """Longest consecutive prefix of the decision's chain the index
+        currently holds for the chosen pod; None when unprobeable (no
+        index attached / no stored hashes / probe error)."""
+        if self.index is None or not rec.chain_hashes:
+            return None
+        try:
+            from ..kvcache.kvblock.keys import Key
+
+            keys = [Key(rec.model, h) for h in rec.chain_hashes]
+            hits = self.index.lookup(keys, {rec.chosen_pod})
+            n = 0
+            for key in keys:
+                if rec.chosen_pod not in (hits.get(key) or []):
+                    break
+                n += 1
+            return n
+        except Exception:
+            log.exception("audit index probe failed")
+            return None
+
+    # -- read side -----------------------------------------------------------
+    def recent(
+        self,
+        limit: int = 50,
+        request_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
+    ) -> list[dict]:
+        with self._mu:
+            rows = list(self._ring)
+        if request_id is not None:
+            rows = [r for r in rows if r.request_id == request_id]
+        if trace_id is not None:
+            rows = [r for r in rows if r.trace_id == trace_id]
+        return [r.to_dict() for r in rows[-max(limit, 0):]]
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            ratios = [r.ratio for r in self._ring if r.ratio is not None]
+            return {
+                "decisions_recorded": self.decisions_recorded,
+                "joined": self.joined,
+                "pending": len(self._pending),
+                "pending_evicted": self.pending_evicted,
+                "unmatched_realized": self.unmatched_realized,
+                "miss_causes": dict(self.miss_causes),
+                "recent_ratio_p50": _percentile(ratios, 0.5),
+            }
+
+
+def debug_staleness_payload(tracker: Optional[StalenessTracker]) -> dict:
+    """``GET /debug/staleness`` body (the endpoint is always routable;
+    with the knob off it reports itself disabled, like /debug/traces)."""
+    if tracker is None:
+        return {"enabled": False}
+    return {"enabled": True, **tracker.detail()}
+
+
+def debug_audit_payload(
+    auditor: Optional[RouteAuditor], query
+) -> tuple[int, dict]:
+    """``GET /debug/audit`` body: recent joined audits, filterable by
+    ``?request_id=`` / ``?trace_id=``; tolerant 400 on a bad limit."""
+    if auditor is None:
+        return 200, {"enabled": False, "audits": []}
+    try:
+        limit = int(query.get("limit", "50"))
+    except ValueError:
+        return 400, {"error": "invalid limit"}
+    return 200, {
+        "enabled": True,
+        "audits": auditor.recent(
+            limit=limit,
+            request_id=query.get("request_id"),
+            trace_id=query.get("trace_id"),
+        ),
+        **auditor.snapshot(),
+    }

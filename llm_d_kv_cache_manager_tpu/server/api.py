@@ -87,6 +87,21 @@ class ServiceConfig:
     obs_tracing: bool = False
     #: finished-span ring size for /debug/traces
     obs_trace_buffer: int = 2048
+    #: routing-quality audit (PR 10): index-staleness probes on event
+    #: ingest (publish→visibility lag per pod/event type, events-behind
+    #: per pod, ``/debug/staleness``) and the predicted-vs-realized route
+    #: audit (scoring requests carrying a ``request_id`` record their
+    #: scoreboard; pods report realized hits via ``RequestAudit`` events;
+    #: joined audits at ``/debug/audit``). Off (default) = no trackers
+    #: attached, bit-identical responses and ``/stats``.
+    obs_audit: bool = False
+    #: joined-audit ring size for /debug/audit
+    obs_audit_ring: int = 2048
+    #: scoring-side OBS_METRICS (PR 10 satellite): the
+    #: ``kvcache_scorer_scoreboard_size`` / ``kvcache_index_events_behind``
+    #: gauges and an ``obs`` block on ``/stats``. Off (default) keeps the
+    #: legacy ``/stats`` field set.
+    obs_metrics: bool = False
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -106,6 +121,11 @@ class ServiceConfig:
             obs_tracing=env.get("OBS_TRACING", "").strip().lower()
             in ("1", "true", "yes", "on"),
             obs_trace_buffer=int(env.get("OBS_TRACE_BUFFER", "2048")),
+            obs_audit=env.get("OBS_AUDIT", "").strip().lower()
+            in ("1", "true", "yes", "on"),
+            obs_audit_ring=int(env.get("OBS_AUDIT_RING", "2048")),
+            obs_metrics=env.get("OBS_METRICS", "").strip().lower()
+            in ("1", "true", "yes", "on"),
         )
 
 
@@ -161,16 +181,41 @@ class ScoringService:
             tokenizer=tokenizer,
             fleet_health=self.fleet_health,
         )
+        #: routing-quality observability (OBS_AUDIT / OBS_METRICS): the
+        #: staleness tracker rides event ingest whenever either surface
+        #: wants it (events-behind needs the seq high-waters); the route
+        #: auditor only with the audit knob. None (default) = the pool
+        #: runs bit-identical legacy.
+        from ..obs.audit import RouteAuditor, StalenessTracker
+
+        self.staleness = (
+            StalenessTracker()
+            if (cfg.obs_audit or cfg.obs_metrics)
+            else None
+        )
+        self.route_auditor = (
+            RouteAuditor(
+                index=self.indexer.kv_block_index,
+                fleet_health=self.fleet_health,
+                ring=cfg.obs_audit_ring,
+            )
+            if cfg.obs_audit
+            else None
+        )
         self.events_pool = KVEventsPool(
             self.indexer.kv_block_index,
             KVEventsPoolConfig(concurrency=cfg.pool_concurrency),
             health=self.fleet_health,
+            staleness=self.staleness,
+            audit=self.route_auditor,
         )
         self.subscriber = ZMQSubscriber(
             self.events_pool,
             ZMQSubscriberConfig(endpoint=cfg.zmq_endpoint, topic_filter=cfg.zmq_topic),
         )
         self.chat = ChatTemplatingProcessor()
+        #: last scoring response's scoreboard size (OBS_METRICS gauge echo)
+        self._last_scoreboard_size = 0
         #: request tracing (OBS_TRACING; a disabled tracer is free)
         self.tracer = Tracer(
             enabled=cfg.obs_tracing,
@@ -217,13 +262,23 @@ class ScoringService:
         if bad is not None:
             return bad
         headers, scores, degraded = await self._traced_score(
-            request, "/score_completions", prompt, model, pods, placement
+            request, "/score_completions", prompt, model, pods, placement,
+            request_id=self._audit_request_id(body),
         )
         if degraded is not None:
             return web.json_response(
                 {"scores": {}, "degraded": degraded}, headers=headers
             )
         return web.json_response({"scores": scores}, headers=headers)
+
+    def _audit_request_id(self, body: dict) -> Optional[str]:
+        """The optional ``request_id`` scoring-body field, read ONLY with
+        the audit knob on — the knobs-off request path inspects no body
+        fields it didn't before."""
+        if self.route_auditor is None:
+            return None
+        rid = body.get("request_id")
+        return rid if isinstance(rid, str) and rid else None
 
     async def _traced_score(
         self,
@@ -233,6 +288,7 @@ class ScoringService:
         model: str,
         pods,
         placement=None,
+        request_id: Optional[str] = None,
     ):
         """The one scoring path both endpoints share: trace mint-or-adopt
         (the scoring service is the fleet's front door, so the trace id
@@ -276,6 +332,34 @@ class ScoringService:
                 return headers, None, str(exc)
             collector.score_latency.observe(time.perf_counter() - t0)
             span.set_attr("pods_scored", len(scores))
+            if self.config.obs_metrics:
+                collector.set_scoreboard_size(len(scores))
+                self._last_scoreboard_size = len(scores)
+            if self.route_auditor is not None and request_id is not None:
+                # The scorer's half of the audit: the scoreboard this
+                # request saw, with the argmax pod standing in for the
+                # caller's eventual pick (the HTTP deployment's router is
+                # external; an in-process BlendedRouter records richer
+                # decisions itself). Empty scoreboard = an honest cold
+                # prediction of 0 blocks.
+                chosen = (
+                    max(scores, key=lambda p: (scores[p], p))
+                    if scores
+                    else ""
+                )
+                self.route_auditor.record_decision(
+                    request_id,
+                    chosen_pod=chosen,
+                    predicted_blocks=scores.get(chosen, 0),
+                    index_blocks=scores.get(chosen, 0),
+                    scoreboard=scores,
+                    model=model,
+                    trace_id=(
+                        span.context.trace_id
+                        if span.context is not None
+                        else None
+                    ),
+                )
         return headers, scores, None
 
     async def handle_score_chat_completions(self, request: web.Request) -> web.Response:
@@ -330,6 +414,7 @@ class ScoringService:
         headers, scores, degraded = await self._traced_score(
             request, "/score_chat_completions", prompt, model,
             body.get("pod_identifiers") or [], placement,
+            request_id=self._audit_request_id(body),
         )
         if degraded is not None:
             # Index backend down: same degradation contract as
@@ -388,24 +473,54 @@ class ScoringService:
         index_size = await asyncio.get_running_loop().run_in_executor(
             None, self._refresh_index_gauges
         )
-        return web.json_response(
-            {
-                "fleet": self.fleet_health.snapshot(),
-                "subscriber": {
-                    "malformed_dropped": dict(self.subscriber.malformed_dropped),
-                },
-                "events_rejected_after_shutdown": (
-                    self.events_pool.rejected_after_shutdown
+        payload = {
+            "fleet": self.fleet_health.snapshot(),
+            "subscriber": {
+                "malformed_dropped": dict(self.subscriber.malformed_dropped),
+            },
+            "events_rejected_after_shutdown": (
+                self.events_pool.rejected_after_shutdown
+            ),
+            "index_size": index_size,
+            "index": collector.snapshot(),
+        }
+        # New blocks only behind their knobs: the knobs-off /stats payload
+        # keeps its legacy field set bit-identical.
+        if self.config.obs_metrics:
+            payload["obs"] = {
+                "scoreboard_size": self._last_scoreboard_size,
+                "events_behind": (
+                    self.staleness.events_behind()
+                    if self.staleness is not None
+                    else {}
                 ),
-                "index_size": index_size,
-                "index": collector.snapshot(),
             }
-        )
+        if self.staleness is not None and self.config.obs_audit:
+            payload["staleness"] = self.staleness.snapshot()
+        if self.route_auditor is not None:
+            payload["audit"] = self.route_auditor.snapshot()
+        return web.json_response(payload)
 
     async def handle_debug_traces(self, request: web.Request) -> web.Response:
         from ..obs.tracing import debug_traces_payload
 
         status, payload = debug_traces_payload(self.tracer, request.query)
+        return web.json_response(payload, status=status)
+
+    async def handle_debug_staleness(self, request: web.Request) -> web.Response:
+        """Per-(pod, event type) publish→visibility histograms + the
+        events-behind gauge state. Reports itself disabled (like
+        /debug/traces) until OBS_AUDIT/OBS_METRICS attaches the tracker."""
+        from ..obs.audit import debug_staleness_payload
+
+        return web.json_response(debug_staleness_payload(self.staleness))
+
+    async def handle_debug_audit(self, request: web.Request) -> web.Response:
+        """Recent joined predicted-vs-realized audits, filterable by
+        ``?request_id=`` / ``?trace_id=``; disabled until OBS_AUDIT."""
+        from ..obs.audit import debug_audit_payload
+
+        status, payload = debug_audit_payload(self.route_auditor, request.query)
         return web.json_response(payload, status=status)
 
     def build_app(self) -> web.Application:
@@ -416,6 +531,8 @@ class ScoringService:
         app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_get("/stats", self.handle_stats)
         app.router.add_get("/debug/traces", self.handle_debug_traces)
+        app.router.add_get("/debug/staleness", self.handle_debug_staleness)
+        app.router.add_get("/debug/audit", self.handle_debug_audit)
         return app
 
 

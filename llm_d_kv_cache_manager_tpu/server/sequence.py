@@ -147,5 +147,21 @@ class Sequence:
             return None
         return self.first_token_time - self.arrival_time
 
+    @property
+    def mean_itl(self) -> Optional[float]:
+        """Mean inter-token latency over the generated tokens; None when
+        not measurable (unfinished, or <= 1 generated token). The one
+        definition both the latency histograms and the SLO recorder feed
+        from — they must never diverge."""
+        if (
+            self.finish_time is None
+            or self.first_token_time is None
+            or self.num_generated <= 1
+        ):
+            return None
+        return max(self.finish_time - self.first_token_time, 0.0) / (
+            self.num_generated - 1
+        )
+
     def is_finished(self) -> bool:
         return self.status == SequenceStatus.FINISHED

@@ -46,6 +46,7 @@ from ..kvcache.kvevents import (
     IndexSnapshot,
     PodDrained,
     PrefillComplete,
+    RequestAudit,
     ZMQPublisher,
     ZMQPublisherConfig,
 )
@@ -295,6 +296,22 @@ class _ServingMetrics:
                 registry=self.registry, buckets=slo_buckets,
             )
             self._host_seen = {"restored": 0, "prefetched": 0}
+            # SLO burn rate (PR 10): in-process evaluation of OBS_SLO
+            # objectives against the same measurements the request
+            # histograms observe; series appear only when an SLORecorder
+            # feeds them (scrape-driven sync).
+            self.slo_burn = prom.Gauge(
+                "kvcache_slo_burn_rate",
+                "Error-budget burn rate per OBS_SLO objective and sliding "
+                "window (1.0 = budget burns at exactly its sustainable "
+                "rate)",
+                ["objective", "window"], registry=self.registry,
+            )
+
+    def set_slo_burn(self, objective: str, window: str, rate: float) -> None:
+        if self._prom is None or not self._obs:
+            return
+        self.slo_burn.labels(objective=objective, window=window).set(rate)
 
     def observe_pull(self, seconds: float, outcome: str) -> None:
         """One ``pull_prefix`` attempt: outcome ok (imported >= 1 block),
@@ -403,11 +420,8 @@ class _ServingMetrics:
             self.req_e2e.labels(**lab).observe(
                 max(seq.finish_time - seq.arrival_time, 0.0)
             )
-            if seq.first_token_time is not None and seq.num_generated > 1:
-                self.req_itl.labels(**lab).observe(
-                    max(seq.finish_time - seq.first_token_time, 0.0)
-                    / (seq.num_generated - 1)
-                )
+            if seq.mean_itl is not None:
+                self.req_itl.labels(**lab).observe(seq.mean_itl)
 
     def sync_lifecycle_stats(self, stats: dict) -> None:
         """Mirror the engine's monotone lifecycle counters (deadline sheds/
@@ -592,6 +606,18 @@ class PodServerConfig:
     #: directory for ``POST /debug/profile`` jax.profiler traces; unset =
     #: the endpoint is disabled.
     obs_profile_dir: Optional[str] = None
+    # -- routing-quality audit + SLO recording (PR 10; off by default = --
+    # -- bit-identical responses, /stats fields, and wire bytes) -----------
+    #: publish a trailing-append ``RequestAudit`` KV event per finished
+    #: request carrying the realized prefix-cache hit count, so the
+    #: indexer's route auditor can join prediction with reality.
+    obs_audit: bool = False
+    #: SLO objectives evaluated in-process against the same measurements
+    #: the PR 5 histograms observe, e.g. ``"ttft:0.5:0.99;itl:0.05:0.95"``
+    #: (metric:threshold_s:target, ";"-separated). Unset = no recorder.
+    obs_slo: str = ""
+    #: burn-rate windows in seconds, e.g. ``"60,300"`` (unset = 60,300)
+    obs_slo_windows: str = ""
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -660,6 +686,9 @@ class PodServerConfig:
         )
         cfg.obs_metrics = _env_bool("OBS_METRICS", "0")
         cfg.obs_profile_dir = os.environ.get("OBS_PROFILE_DIR") or None
+        cfg.obs_audit = _env_bool("OBS_AUDIT", "0")
+        cfg.obs_slo = os.environ.get("OBS_SLO", "")
+        cfg.obs_slo_windows = os.environ.get("OBS_SLO_WINDOWS", "")
 
         eng = cfg.engine
         eng.block_manager = BlockManagerConfig(
@@ -848,6 +877,21 @@ class PodServer:
         self.role_clamped_requests = 0  # guarded_by: _mu|_work
         #: PrefillComplete events published (handoff supply)
         self.prefill_completes_published = 0  # guarded_by: _mu|_work
+        # -- routing-quality audit + SLO recording (PR 10; both off by ------
+        # -- default = nothing below runs) -----------------------------------
+        #: RequestAudit events published (realized-hit ground truth)
+        self.audits_published = 0  # guarded_by: _mu|_work
+        #: in-process SLO burn-rate recorder (OBS_SLO; None = off). A
+        #: malformed spec raises HERE, at construction — a silently
+        #: dropped objective would read as a perfectly green SLO.
+        self.slo = None
+        if self.config.obs_slo.strip():
+            from ..obs.slo import SLORecorder, parse_slo_spec, parse_windows
+
+            self.slo = SLORecorder(
+                parse_slo_spec(self.config.obs_slo),
+                windows_s=parse_windows(self.config.obs_slo_windows),
+            )
 
         # -- fleet self-healing (heartbeats + periodic resync) --------------
         # Digest reads hop onto the engine loop like exports/imports: page
@@ -1097,8 +1141,44 @@ class PodServer:
             # the importing state).
             job["cancel"].set()
         self.metrics.observe_finished(seq)
+        if self.slo is not None:
+            # Same measurements the latency histograms observe (the
+            # shared Sequence.ttft/mean_itl definitions), so the burn
+            # rate stays a faithful in-process cross-check of them.
+            self.slo.observe(seq.ttft, seq.mean_itl)
         if seq.trace_span is not None:
             self._emit_request_spans(seq)
+        if (
+            self.config.obs_audit
+            and self._publisher is not None
+            and seq.prefill_start_time is not None
+        ):
+            # Realized-hit ground truth for the route audit: how many
+            # prompt blocks this pod's prefix cache actually served at
+            # first prefill. Requests that never reached prefill
+            # (shed/aborted while queued) realized nothing measurable —
+            # reporting 0 for them would charge the scorer with misses
+            # the routing never caused. Failures are swallowed like
+            # heartbeats: auditing must never fail a request.
+            try:
+                self._publisher.publish(
+                    [
+                        RequestAudit(
+                            request_id=seq.request_id or "",
+                            realized_blocks=(
+                                seq.num_cached_prompt
+                                // max(
+                                    self.config.engine.block_manager.page_size,
+                                    1,
+                                )
+                            ),
+                        )
+                    ]
+                )
+                with self._mu:
+                    self.audits_published += 1
+            except Exception:
+                log.exception("RequestAudit publish failed")
         if (
             self.config.pod_role == "prefill"
             and self._publisher is not None
@@ -2142,6 +2222,7 @@ class PodServer:
                 async_canceled = self.async_pull_canceled
                 role_clamped = self.role_clamped_requests
                 prefill_completes = self.prefill_completes_published
+                audits_published = self.audits_published
             payload = {
                 "pod": self.config.pod_identifier,
                 "model": self.config.model_name,
@@ -2231,9 +2312,20 @@ class PodServer:
                     },
                     "loop_lag_s": self._loop_lag_s,
                 }
+            if self.config.obs_audit:
+                # Audit block only with the knob on: the knobs-off /stats
+                # payload stays bit-identical.
+                payload["audit"] = {"published": audits_published}
+            if self.slo is not None:
+                # SLO block only when OBS_SLO configured an objective.
+                payload["slo"] = self.slo.snapshot()
             return web.json_response(payload)
 
         async def metrics(_request: web.Request) -> web.Response:
+            if self.slo is not None:
+                # Scrape-driven: burn rates recompute here, like the
+                # indexer's occupancy gauges.
+                self.slo.sync_gauges(self.metrics.set_slo_burn)
             body = self.metrics.exposition()
             if body is None:
                 return web.json_response(

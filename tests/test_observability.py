@@ -228,14 +228,25 @@ _POD_OBS_METRICS = {
     "kvcache_host_pages": "gauge",
     "kvcache_host_hits_total": "counter",
     "kvcache_host_prefetch_seconds": "histogram",
+    # SLO burn-rate recording (ISSUE 10; series appear when OBS_SLO feeds
+    # them, the family is registered with the obs surface)
+    "kvcache_slo_burn_rate": "gauge",
 }
 
-#: Scorer-side collector metrics added by PR 5 (global registry).
+#: Scorer-side collector metrics added by PR 5 + the ISSUE 10 audit plane
+#: (global registry).
 _SCORER_OBS_METRICS = {
     "kvcache_scorer_route_decisions_total": "counter",
     "kvcache_scorer_score_seconds": "histogram",
     "kvcache_index_blocks": "gauge",
     "kvcache_index_pods": "gauge",
+    # Routing-quality audit plane (ISSUE 10)
+    "kvcache_index_staleness_seconds": "histogram",
+    "kvcache_index_events_behind": "gauge",
+    "kvcache_scorer_scoreboard_size": "gauge",
+    "kvcache_route_predicted_vs_realized_blocks": "histogram",
+    "kvcache_route_regret_blocks": "histogram",
+    "kvcache_route_miss_attributed_total": "counter",
 }
 
 
