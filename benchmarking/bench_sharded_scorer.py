@@ -1,0 +1,477 @@
+"""Sharded-control-plane benchmark: event ingest + score reads at fleet scale.
+
+The singleton scoring service has two hot surfaces that saturate long
+before the TPU pods do: the KV-event apply plane (every pod's
+BlockStored/BlockRemoved traffic funnels into one index) and the score
+RPC (every routing decision reads it). This benchmark drives both at
+once, on the REAL stack — real msgpack wire payloads through the real
+pool/plane, real index backends, real ``KVCacheIndexer.score_tokens``
+reads racing the ingest — for a single-index arm and a
+``SCORER_SHARDS``-partitioned arm. Two phases per arm:
+
+- **capacity** (firehose): N simulated pods (64 default) publish
+  16-hash BlockStored batches as fast as the plane accepts them; the
+  number is applied KV events/second, wall-clocked from first enqueue
+  to drain. Score readers run THROUGHOUT at a fixed pace (closed-loop
+  spinning readers would just measure GIL theft).
+- **paced** (the acceptance regime): the same traffic paced at
+  BENCH_SHARD_RATE KV events/s (default 100_000). Staleness p50/p99
+  come from fresh product ``StalenessTracker``(s) riding the plane
+  exactly as the service attaches them (publish→visibility, wall
+  clock); ``sustained`` is whether the producer held the rate AND the
+  backlog drained within the phase budget. Score p50/p99 per read is
+  the same quantity ``kvcache_scorer_score_seconds`` pins in
+  production.
+
+Note on parallelism: the per-shard apply workers only run truly
+concurrently where the index releases the GIL — the C++ ``lruindex``
+backend (ctypes calls drop the GIL); that is the production
+configuration and the default here (BENCH_SHARD_NATIVE=0 forces the
+pure-Python backend for comparison).
+
+One JSON line per arm plus a ``summary`` line. Env knobs:
+BENCH_SHARD_PODS (64), BENCH_SHARD_EVENTS (total KV events in the
+capacity phase, 200_000), BENCH_SHARD_RATE (paced-phase KV events/s,
+100_000), BENCH_SHARD_PACED_S (paced-phase seconds, 3), BENCH_SHARD_ARMS
+("0,4"), BENCH_SHARD_READ_INTERVAL_MS (per-reader read cadence, 5),
+BENCH_SHARD_READERS (2), BENCH_REPEATS (median-of-N rounds).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: blocks per BlockStored event (one engine step's chain growth)
+BLOCKS_PER_EVENT = 16
+#: BlockStored events per wire batch (the publisher batches per step)
+EVENTS_PER_BATCH = int(os.environ.get("BENCH_SHARD_BATCH_EVENTS", "8"))
+#: block-level KV events per wire batch — the capacity unit: one stored
+#: block = one KV event (a BlockStored carrying 16 blocks records 16)
+BLOCKS_PER_BATCH = BLOCKS_PER_EVENT * EVENTS_PER_BATCH
+
+
+def _percentile(samples, q):
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(int(q * len(s)), len(s) - 1)]
+
+
+def build_backend(native: bool):
+    """Returns (make_one, make_group, backend_name): ``make_group(n)``
+    builds the sharded arm's sub-indexes — for the native backend a
+    shared-intern shard group, which is the production SCORER_SHARDS
+    configuration (and what enables the one-C-call score fan)."""
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+        InMemoryIndex,
+        InMemoryIndexConfig,
+        NativeMemoryIndex,
+        NativeMemoryIndexConfig,
+        native_available,
+    )
+
+    if native and native_available():
+        cfg = NativeMemoryIndexConfig(size=2_000_000, pod_cache_size=8)
+        return (
+            lambda: NativeMemoryIndex(cfg),
+            lambda n: NativeMemoryIndex.shard_group(n, cfg),
+            "native",
+        )
+    mem_cfg = InMemoryIndexConfig(size=2_000_000, pod_cache_size=8)
+    return (
+        lambda: InMemoryIndex(mem_cfg),
+        lambda n: [InMemoryIndex(mem_cfg) for _ in range(n)],
+        "in_memory",
+    )
+
+
+class _Arm:
+    """One arm's live plane + indexer + paced readers."""
+
+    def __init__(self, n_shards, backends, model, n_readers, read_interval_s):
+        from llm_d_kv_cache_manager_tpu.kvcache import (
+            KVCacheIndexer,
+            KVCacheIndexerConfig,
+            ShardedEventsPool,
+            ShardedEventsPoolConfig,
+            ShardedIndex,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+            KVEventsPool,
+            KVEventsPoolConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.obs.audit import StalenessTracker
+
+        self.model = model
+        self.n_shards = n_shards
+        dispatchers = int(os.environ.get("POOL_CONCURRENCY", "4"))
+        if n_shards > 0:
+            # The dispatch stage (decode + split) is cheap relative to the
+            # per-shard applies; extra dispatcher threads on a small host
+            # only add GIL queuing ahead of score reads.
+            dispatchers = int(
+                os.environ.get("BENCH_SHARD_DISPATCHERS", "0")
+            ) or dispatchers
+            self.index = ShardedIndex(backends[1](n_shards))
+            self.trackers = [StalenessTracker(shard=str(i)) for i in range(n_shards)]
+            self.plane = ShardedEventsPool(
+                self.index,
+                ShardedEventsPoolConfig(dispatchers=dispatchers),
+                staleness=self.trackers,
+            )
+        else:
+            self.index = backends[0]()
+            self.trackers = [StalenessTracker()]
+            self.plane = KVEventsPool(
+                self.index, KVEventsPoolConfig(concurrency=dispatchers),
+                staleness=self.trackers[0],
+            )
+        self.indexer = KVCacheIndexer(KVCacheIndexerConfig(), index=self.index)
+        self.read_interval_s = read_interval_s
+        self.n_readers = n_readers
+        self.warm_tokens = list(range(BLOCKS_PER_EVENT * 8))
+        self._read_lat: list[float] = []
+        self._read_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._readers: list[threading.Thread] = []
+
+    # -- readers -------------------------------------------------------------
+    def _reader(self):
+        interval = self.read_interval_s
+        nxt = time.perf_counter()
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            scores = self.indexer.score_tokens(self.warm_tokens, self.model)
+            dt = time.perf_counter() - t0
+            assert isinstance(scores, dict)
+            with self._read_mu:
+                self._read_lat.append(dt)
+            nxt += interval
+            delay = nxt - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                nxt = time.perf_counter()  # behind schedule: don't burst
+
+    def start(self):
+        self.plane.start()
+        self._readers = [
+            threading.Thread(target=self._reader) for _ in range(self.n_readers)
+        ]
+        for t in self._readers:
+            t.start()
+
+    def stop(self):
+        self._stop.set()
+        for t in self._readers:
+            t.join()
+        self.plane.shutdown()
+        self.indexer.shutdown()
+
+    def take_read_latencies(self):
+        with self._read_mu:
+            out, self._read_lat = self._read_lat, []
+        return out
+
+    def staleness_samples(self):
+        samples = []
+        for tr in self.trackers:
+            with tr._mu:
+                samples.extend(tr._samples)
+                tr._samples.clear()
+        return samples
+
+    # -- traffic -------------------------------------------------------------
+    def publish(self, pod_idx: int, seq: int, start_hash: int):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+            BlockStored,
+            EventBatch,
+            Message,
+        )
+
+        pod = f"pod-{pod_idx:03d}"
+        events = [
+            BlockStored(
+                block_hashes=list(
+                    range(
+                        start_hash + j * BLOCKS_PER_EVENT,
+                        start_hash + (j + 1) * BLOCKS_PER_EVENT,
+                    )
+                )
+            )
+            for j in range(EVENTS_PER_BATCH)
+        ]
+        self.plane.add_task(
+            Message(
+                topic=f"kv@{pod}@{self.model}",
+                pod_identifier=pod,
+                model_name=self.model,
+                payload=EventBatch(ts=time.time(), events=events).to_payload(),
+                seq=seq,
+            )
+        )
+
+    def warm(self, n_pods):
+        """Every pod claims one shared chain so reads score a real
+        multi-pod scoreboard."""
+        hashes = self.indexer.token_processor.prefix_hashes(self.warm_tokens)
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+            BlockStored,
+            EventBatch,
+            Message,
+        )
+
+        for p in range(n_pods):
+            pod = f"pod-{p:03d}"
+            self.plane.add_task(
+                Message(
+                    topic=f"kv@{pod}@{self.model}",
+                    pod_identifier=pod,
+                    model_name=self.model,
+                    payload=EventBatch(
+                        ts=time.time(),
+                        events=[BlockStored(block_hashes=hashes)],
+                    ).to_payload(),
+                    seq=0,
+                )
+            )
+        self.plane.drain(30)
+
+
+def run_arm(n_shards, *, n_pods, n_events, rate, paced_s, n_readers,
+            read_interval_s, backends, model):
+    arm = _Arm(n_shards, backends, model, n_readers, read_interval_s)
+    arm.warm(n_pods)
+    arm.start()
+    base = 1 << 32
+    seqs = [0] * n_pods
+
+    # -- capacity phase: firehose ------------------------------------------
+    n_batches = max(n_events // BLOCKS_PER_BATCH, 1)
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        p = i % n_pods
+        seqs[p] += 1
+        arm.publish(p, seqs[p], base + i * BLOCKS_PER_BATCH)
+    drained = arm.plane.drain(600)
+    capacity_wall = time.perf_counter() - t0
+    cap_read_lat = arm.take_read_latencies()
+    arm.staleness_samples()  # discard: firehose staleness is backlog depth
+
+    # -- paced phase: the acceptance regime ---------------------------------
+    paced_batches_s = rate / BLOCKS_PER_BATCH
+    interval = 1.0 / paced_batches_s
+    n_paced = int(paced_s * paced_batches_s)
+    base2 = 1 << 40
+    behind_max = 0.0
+    t1 = time.perf_counter()
+    nxt = t1
+    for i in range(n_paced):
+        p = i % n_pods
+        seqs[p] += 1
+        arm.publish(p, seqs[p], base2 + i * BLOCKS_PER_BATCH)
+        nxt += interval
+        delay = nxt - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        else:
+            behind_max = max(behind_max, -delay)
+    produce_wall = time.perf_counter() - t1
+    paced_drained = arm.plane.drain(60)
+    paced_wall = time.perf_counter() - t1
+    paced_read_lat = arm.take_read_latencies()
+    paced_staleness = arm.staleness_samples()
+    arm.stop()
+
+    # -- quiescent reads: the read path's own cost, no ingest racing it ----
+    quiet_lat = []
+    for _ in range(300):
+        t0q = time.perf_counter()
+        arm.indexer.score_tokens(arm.warm_tokens, model)
+        quiet_lat.append(time.perf_counter() - t0q)
+
+    produced_rate = n_paced * BLOCKS_PER_BATCH / produce_wall if n_paced else 0.0
+    sustained = (
+        paced_drained
+        and produced_rate >= 0.95 * rate
+        # the backlog cleared in step with production, not long after
+        and paced_wall <= produce_wall * 1.1 + 1.0
+    )
+    return {
+        "shards": n_shards,
+        "pods": n_pods,
+        "capacity": {
+            "kv_events": n_batches * BLOCKS_PER_BATCH,
+            "batches": n_batches,
+            "events_per_batch": EVENTS_PER_BATCH,
+            "blocks_per_event": BLOCKS_PER_EVENT,
+            "wall_s": round(capacity_wall, 4),
+            "kv_events_per_s": round(n_batches * BLOCKS_PER_BATCH / capacity_wall, 1),
+            "drained": drained,
+            "score_p50_ms": round((_percentile(cap_read_lat, 0.5) or 0) * 1e3, 3),
+            "score_p99_ms": round((_percentile(cap_read_lat, 0.99) or 0) * 1e3, 3),
+        },
+        "paced": {
+            "target_kv_events_per_s": rate,
+            "produced_kv_events_per_s": round(produced_rate, 1),
+            "seconds": round(paced_wall, 3),
+            "sustained": sustained,
+            "producer_behind_max_s": round(behind_max, 4),
+            "staleness_p50_ms": round(
+                (_percentile(paced_staleness, 0.5) or 0) * 1e3, 3
+            ),
+            "staleness_p99_ms": round(
+                (_percentile(paced_staleness, 0.99) or 0) * 1e3, 3
+            ),
+            "staleness_samples": len(paced_staleness),
+            "score_reads": len(paced_read_lat),
+            "score_p50_ms": round((_percentile(paced_read_lat, 0.5) or 0) * 1e3, 3),
+            "score_p99_ms": round((_percentile(paced_read_lat, 0.99) or 0) * 1e3, 3),
+        },
+        "quiescent": {
+            "score_p50_ms": round((_percentile(quiet_lat, 0.5) or 0) * 1e3, 3),
+            "score_p99_ms": round((_percentile(quiet_lat, 0.99) or 0) * 1e3, 3),
+        },
+    }
+
+
+def main() -> int:
+    model = "bench-model"
+    n_pods = int(os.environ.get("BENCH_SHARD_PODS", "64"))
+    n_events = int(os.environ.get("BENCH_SHARD_EVENTS", "200000"))
+    rate = int(os.environ.get("BENCH_SHARD_RATE", "100000"))
+    paced_s = float(os.environ.get("BENCH_SHARD_PACED_S", "3"))
+    n_readers = int(os.environ.get("BENCH_SHARD_READERS", "2"))
+    read_interval_s = (
+        float(os.environ.get("BENCH_SHARD_READ_INTERVAL_MS", "5")) / 1e3
+    )
+    arms = [
+        int(a)
+        for a in os.environ.get("BENCH_SHARD_ARMS", "0,4").split(",")
+        if a.strip()
+    ]
+    repeats = int(os.environ.get("BENCH_REPEATS", "1"))
+    make_one, make_group, backend = build_backend(
+        os.environ.get("BENCH_SHARD_NATIVE", "1") == "1"
+    )
+    backends = (make_one, make_group)
+
+    # Rounds INTERLEAVE the arms (single, sharded, single, sharded, ...):
+    # on a noisy shared-CPU host, arms run minutes apart see different
+    # machines — adjacency plus per-metric medians is what makes the
+    # cross-arm ratios comparable at all.
+    rounds_by_arm: dict[int, list[dict]] = {s: [] for s in arms}
+    # One discarded warm-up pass per arm (quarter-size): the first rounds
+    # on a cold process/host measure page-cache and allocator warm-up, not
+    # the plane.
+    for shards in arms:
+        run_arm(
+            shards,
+            n_pods=n_pods,
+            n_events=max(n_events // 4, BLOCKS_PER_BATCH),
+            rate=rate,
+            paced_s=min(paced_s, 1.0),
+            n_readers=n_readers,
+            read_interval_s=read_interval_s,
+            backends=backends,
+            model=model,
+        )
+    for _ in range(repeats):
+        for shards in arms:
+            rounds_by_arm[shards].append(
+                run_arm(
+                    shards,
+                    n_pods=n_pods,
+                    n_events=n_events,
+                    rate=rate,
+                    paced_s=paced_s,
+                    n_readers=n_readers,
+                    read_interval_s=read_interval_s,
+                    backends=backends,
+                    model=model,
+                )
+            )
+
+    def med(rows, *path):
+        vals = []
+        for r in rows:
+            v = r
+            for p in path:
+                v = v[p]
+            if v is not None:
+                vals.append(v)
+        return round(statistics.median(vals), 3) if vals else None
+
+    results = {}
+    for shards in arms:
+        rounds = rounds_by_arm[shards]
+        caps = sorted(r["capacity"]["kv_events_per_s"] for r in rounds)
+        res = {
+            "shards": shards,
+            "backend": backend,
+            "pods": n_pods,
+            "rounds": len(rounds),
+            "events_per_batch": EVENTS_PER_BATCH,
+            "blocks_per_event": BLOCKS_PER_EVENT,
+            # per-metric medians across rounds (NOT one median round)
+            "capacity_kv_events_per_s": med(rounds, "capacity", "kv_events_per_s"),
+            "capacity_kv_events_per_s_spread": {
+                "min": caps[0], "max": caps[-1],
+            },
+            "paced_target_kv_events_per_s": rate,
+            "paced_sustained_rounds": sum(
+                1 for r in rounds if r["paced"]["sustained"]
+            ),
+            "paced_staleness_p50_ms": med(rounds, "paced", "staleness_p50_ms"),
+            "paced_staleness_p99_ms": med(rounds, "paced", "staleness_p99_ms"),
+            "paced_score_p50_ms": med(rounds, "paced", "score_p50_ms"),
+            "paced_score_p99_ms": med(rounds, "paced", "score_p99_ms"),
+            "quiescent_score_p50_ms": med(rounds, "quiescent", "score_p50_ms"),
+            "quiescent_score_p99_ms": med(rounds, "quiescent", "score_p99_ms"),
+            "rounds_detail": rounds,
+        }
+        results[shards] = res
+        print(json.dumps(res))
+
+    if 0 in results and any(s > 0 for s in results):
+        sharded = results[max(results)]
+        single = results[0]
+        print(
+            json.dumps(
+                {
+                    "summary": True,
+                    "backend": backend,
+                    "pods": n_pods,
+                    "rounds": repeats,
+                    "capacity_speedup_sharded_over_single": round(
+                        sharded["capacity_kv_events_per_s"]
+                        / single["capacity_kv_events_per_s"],
+                        3,
+                    ),
+                    "paced_sustained_single": single["paced_sustained_rounds"],
+                    "paced_sustained_sharded": sharded["paced_sustained_rounds"],
+                    "staleness_p99_ms_single": single["paced_staleness_p99_ms"],
+                    "staleness_p99_ms_sharded": sharded["paced_staleness_p99_ms"],
+                    "score_p99_ms_single": single["paced_score_p99_ms"],
+                    "score_p99_ms_sharded": sharded["paced_score_p99_ms"],
+                    "quiescent_score_p99_ms_single": single[
+                        "quiescent_score_p99_ms"
+                    ],
+                    "quiescent_score_p99_ms_sharded": sharded[
+                        "quiescent_score_p99_ms"
+                    ],
+                }
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

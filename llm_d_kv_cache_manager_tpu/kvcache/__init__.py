@@ -17,6 +17,12 @@ from .scorer import (
     ScoringStrategy,
     new_scorer,
 )
+from .sharding import (
+    HashRing,
+    ShardedEventsPool,
+    ShardedEventsPoolConfig,
+    ShardedIndex,
+)
 
 __all__ = [
     "BlendedRouter",
@@ -35,4 +41,8 @@ __all__ = [
     "LongestPrefixScorer",
     "ScoringStrategy",
     "new_scorer",
+    "HashRing",
+    "ShardedEventsPool",
+    "ShardedEventsPoolConfig",
+    "ShardedIndex",
 ]

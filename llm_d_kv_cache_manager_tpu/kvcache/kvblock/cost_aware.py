@@ -116,6 +116,12 @@ class CostAwareMemoryIndex(Index):
             pods = {e.pod_identifier for ps in self._data.values() for e in ps}
             return {"blocks": len(self._data), "pods": len(pods)}
 
+    def pod_names(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                {e.pod_identifier for ps in self._data.values() for e in ps}
+            )
+
     def evict_pod(self, pod_identifier: str) -> int:
         removed = 0
         with self._lock:

@@ -116,6 +116,13 @@ class InMemoryIndex(Index):
                 pods.update(e.pod_identifier for e in pod_cache.cache.keys())
         return {"blocks": blocks, "pods": len(pods)}
 
+    def pod_names(self) -> list[str]:
+        pods: set[str] = set()
+        for _key, pod_cache in self._data.items():
+            with pod_cache.mu:
+                pods.update(e.pod_identifier for e in pod_cache.cache.keys())
+        return sorted(pods)
+
     def evict_pod(self, pod_identifier: str) -> int:
         removed = 0
         # items() snapshots without promoting, so a sweep does not disturb

@@ -57,6 +57,14 @@ class Index(ABC):
         the backend cannot answer cheaply (e.g. a remote Redis)."""
         return None
 
+    def pod_names(self) -> Optional[Sequence[str]]:
+        """Distinct pod identifiers the backend can enumerate cheaply (for
+        the native backend: pods ever interned, a documented superset).
+        Lets ``ShardedIndex`` union pods across shards so the aggregate
+        ``size_info`` stays truthful. None when enumeration would require
+        a remote walk (e.g. Redis) — callers fall back to counts."""
+        return None
+
 
 @dataclass
 class InMemoryIndexConfig:

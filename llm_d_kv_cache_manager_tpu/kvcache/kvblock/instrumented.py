@@ -29,6 +29,10 @@ class InstrumentedIndex(Index):
         # default, so __getattr__ never fires for this name.
         return self._inner.size_info()
 
+    def pod_names(self):
+        # Same explicit-delegation rule as size_info.
+        return self._inner.pod_names()
+
     def lookup(
         self, keys: Sequence[Key], pod_filter: Optional[set[str]] = None
     ) -> dict[Key, list[str]]:

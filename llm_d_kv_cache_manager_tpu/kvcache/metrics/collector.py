@@ -85,6 +85,14 @@ scoreboard_size = _NullMetric()
 route_pvr = _NullMetric()
 route_regret = _NullMetric()
 route_miss = _NullMetric()
+# Sharded control plane (PR 11): per-shard index occupancy and stale-ring
+# misroute forwards. Series appear only when SCORER_SHARDS partitions the
+# index — a knobs-off process never touches a shard label (the staleness /
+# events-behind families above likewise grow a ``shard`` label that stays
+# "" until the sharded plane feeds them).
+shard_blocks = _NullMetric()
+shard_pods = _NullMetric()
+shard_misroutes = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -122,6 +130,7 @@ def register(registry=None) -> None:
     global route_decisions, score_latency, index_blocks, index_pods
     global index_staleness, index_events_behind, scoreboard_size
     global route_pvr, route_regret, route_miss
+    global shard_blocks, shard_pods, shard_misroutes
     with _lock:
         if _registered:
             return
@@ -225,8 +234,9 @@ def register(registry=None) -> None:
         index_staleness = _prom.Histogram(
             "kvcache_index_staleness_seconds",
             "Event-plane lag: publish timestamp to index application, per "
-            "pod and event type (OBS_AUDIT)",
-            ["pod", "event"],
+            "pod and event type (OBS_AUDIT); the shard label is \"\" on a "
+            "single index and the owning scorer shard under SCORER_SHARDS",
+            ["pod", "event", "shard"],
             registry=registry,
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
@@ -235,8 +245,10 @@ def register(registry=None) -> None:
             "kvcache_index_events_behind",
             "Events received from a pod's publisher but not yet applied "
             "to the index (subscriber seq high-water minus worker "
-            "high-water; refreshed on /stats and /metrics scrapes)",
-            ["pod"],
+            "high-water; refreshed on /stats and /metrics scrapes); the "
+            "shard label is \"\" on a single index and the ingest lane's "
+            "shard under SCORER_SHARDS",
+            ["pod", "shard"],
             registry=registry,
         )
         scoreboard_size = _prom.Gauge(
@@ -272,6 +284,29 @@ def register(registry=None) -> None:
             ["cause"],
             registry=registry,
         )
+        shard_blocks = _prom.Gauge(
+            "kvcache_index_shard_blocks",
+            "Block keys tracked by one scorer shard's sub-index "
+            "(SCORER_SHARDS; refreshed on /stats and /metrics scrapes)",
+            ["shard"],
+            registry=registry,
+        )
+        shard_pods = _prom.Gauge(
+            "kvcache_index_shard_pods",
+            "Distinct pods holding at least one entry on one scorer "
+            "shard's sub-index (SCORER_SHARDS; refreshed on /stats and "
+            "/metrics scrapes)",
+            ["shard"],
+            registry=registry,
+        )
+        shard_misroutes = _prom.Counter(
+            "kvcache_shard_misroute_total",
+            "Event ops that landed on a stale-ring shard and were "
+            "forwarded once to the current owner (SCORER_SHARDS resize "
+            "in flight), labeled by the shard that observed the misroute",
+            ["shard"],
+            registry=registry,
+        )
         _registered = True
 
 
@@ -281,14 +316,28 @@ def observe_route_decision(action: str) -> None:
     route_decisions.labels(decision=action).inc()
 
 
-def observe_staleness(pod: str, event: str, lag_s: float) -> None:
-    """One event's publish→index-application lag (OBS_AUDIT)."""
+def observe_staleness(pod: str, event: str, lag_s: float, shard: str = "") -> None:
+    """One event's publish→index-application lag (OBS_AUDIT). ``shard``
+    is "" on a single index; the sharded plane labels each observation
+    with the applying shard."""
     bump("staleness_events")
-    index_staleness.labels(pod=pod, event=event).observe(lag_s)
+    index_staleness.labels(pod=pod, event=event, shard=shard).observe(lag_s)
 
 
-def set_events_behind(pod: str, behind: int) -> None:
-    index_events_behind.labels(pod=pod).set(behind)
+def set_events_behind(pod: str, behind: int, shard: str = "") -> None:
+    index_events_behind.labels(pod=pod, shard=shard).set(behind)
+
+
+def set_shard_index_size(shard: str, blocks: int, pods: int) -> None:
+    """Refresh one scorer shard's occupancy gauges (scrape-driven)."""
+    shard_blocks.labels(shard=shard).set(blocks)
+    shard_pods.labels(shard=shard).set(pods)
+
+
+def observe_shard_misroute(shard: str, n: int = 1) -> None:
+    """Stale-ring misroute forwards observed by ``shard`` (SCORER_SHARDS)."""
+    bump("shard_misroutes", n)
+    shard_misroutes.labels(shard=shard).inc(n)
 
 
 def set_scoreboard_size(n: int) -> None:

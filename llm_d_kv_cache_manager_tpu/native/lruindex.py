@@ -61,6 +61,30 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.lruidx_evict_pod.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         except AttributeError:
             pass
+        try:  # PR-11 symbol: shared-lock read-side lookup (no LRU promote)
+            lib.lruidx_lookup_ro.restype = ctypes.c_uint64
+            lib.lruidx_lookup_ro.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, _u64p, ctypes.c_uint64,
+                _u32p, ctypes.c_uint64, _u32p, _u8p, _u32p,
+            ]
+        except AttributeError:
+            pass
+        try:  # PR-11 symbol: exact distinct-pod occupancy walk
+            lib.lruidx_distinct_pods.restype = ctypes.c_uint64
+            lib.lruidx_distinct_pods.argtypes = [
+                ctypes.c_void_p, _u32p, ctypes.c_uint64,
+            ]
+        except AttributeError:
+            pass
+        try:  # PR-11 symbol: one-call cross-shard fused scoring
+            lib.lruidx_score_sharded.restype = ctypes.c_uint64
+            lib.lruidx_score_sharded.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint64,
+                ctypes.c_uint32, _u64p, _u32p, ctypes.c_uint64,
+                _u32p, ctypes.c_uint64, _u32p, _u32p, _u64p,
+            ]
+        except AttributeError:
+            pass
         _lib = lib
     except OSError:
         _lib = None
@@ -132,6 +156,40 @@ class NativeLru:
             r += c
         return processed, result
 
+    @property
+    def has_lookup_ro(self) -> bool:
+        return hasattr(self._lib, "lruidx_lookup_ro")
+
+    def lookup_ro(self, model: int, hashes, filter_ids):
+        """Read-side lookup: same outputs and early-stop semantics as
+        ``lookup``, but under the C++ shared lock with NO recency
+        promotion — safe (and concurrent) against in-flight applies.
+        Raises when the loaded library predates the symbol."""
+        if not self.has_lookup_ro:
+            raise RuntimeError(
+                "liblruindex.so predates lruidx_lookup_ro — rebuild with "
+                "`python -m llm_d_kv_cache_manager_tpu.native.build`"
+            )
+        n_keys = len(hashes)
+        n_filter = len(filter_ids)
+        cap = n_keys * self.pods_per_key
+        out_pods = (ctypes.c_uint32 * cap)()
+        out_tiers = (ctypes.c_uint8 * cap)()
+        out_counts = (ctypes.c_uint32 * n_keys)()
+        processed = self._lib.lruidx_lookup_ro(
+            self._h, model,
+            (ctypes.c_uint64 * n_keys)(*hashes), n_keys,
+            (ctypes.c_uint32 * max(1, n_filter))(*(filter_ids or [0])),
+            n_filter, out_pods, out_tiers, out_counts,
+        )
+        result = []
+        r = 0
+        for i in range(processed):
+            c = out_counts[i]
+            result.append([(out_pods[r + j], out_tiers[r + j]) for j in range(c)])
+            r += c
+        return processed, result
+
     def score(self, model: int, hashes, filter_ids):
         """Fused longest-prefix scoring.
 
@@ -163,3 +221,50 @@ class NativeLru:
 
     def size(self) -> int:
         return self._lib.lruidx_size(self._h)
+
+    def distinct_pods(self, cap: int):
+        """Exact distinct pod ids currently holding >= 1 entry (shared-lock
+        O(entries) walk — scrape-driven callers only). Returns None when
+        the loaded library predates the symbol (caller falls back to the
+        ever-interned approximation)."""
+        if not hasattr(self._lib, "lruidx_distinct_pods"):
+            return None
+        cap = max(int(cap), 1)
+        out = (ctypes.c_uint32 * cap)()
+        n = int(self._lib.lruidx_distinct_pods(self._h, out, cap))
+        return [out[i] for i in range(min(n, cap))]
+
+
+def score_sharded_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "lruidx_score_sharded")
+
+
+def score_sharded(lrus, model: int, hashes, owners, filter_ids):
+    """One-call fused longest-prefix scoring over a chain whose keys are
+    partitioned across ``lrus`` (``owners[i]`` indexes key i's shard):
+    every shard is shared-locked inside the call (concurrent with
+    applies), no LRU promotion, one GIL release round trip total. Pod ids
+    MUST be interned in one table shared by all shards. Returns
+    ``([(pod_id, score)], hits)`` like ``NativeLru.score``."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "lruidx_score_sharded"):
+        raise RuntimeError(
+            "liblruindex.so predates lruidx_score_sharded — rebuild with "
+            "`python -m llm_d_kv_cache_manager_tpu.native.build`"
+        )
+    n_keys = len(hashes)
+    n_filter = len(filter_ids)
+    handles = (ctypes.c_void_p * len(lrus))(*[lru._h for lru in lrus])
+    cap = max(lru.pods_per_key for lru in lrus)
+    out_pods = (ctypes.c_uint32 * cap)()
+    out_scores = (ctypes.c_uint32 * cap)()
+    out_hits = (ctypes.c_uint64 * 1)()
+    n = lib.lruidx_score_sharded(
+        handles, len(lrus), model,
+        (ctypes.c_uint64 * n_keys)(*hashes),
+        (ctypes.c_uint32 * n_keys)(*owners), n_keys,
+        (ctypes.c_uint32 * max(1, n_filter))(*(filter_ids or [0])),
+        n_filter, out_pods, out_scores, out_hits,
+    )
+    return [(out_pods[i], out_scores[i]) for i in range(n)], int(out_hits[0])

@@ -114,8 +114,14 @@ class StalenessTracker:
         self,
         clock: Callable[[], float] = time.time,
         max_samples: int = 8192,
+        shard: str = "",
     ):
+        """``shard`` labels every metric observation this tracker makes:
+        "" (the default) on a single index; the sharded control plane runs
+        one tracker per scorer shard so a drowning ingest lane is visible
+        per shard."""
         self._clock = clock
+        self.shard = shard
         self._mu = threading.Lock()
         #: (pod, event_tag) -> _LagHist
         self._hists: dict[tuple[str, str], _LagHist] = {}  # guarded_by: _mu
@@ -160,7 +166,7 @@ class StalenessTracker:
             self._samples.append(lag)
             self.max_lag_s = max(self.max_lag_s, lag)
         for tag in event_tags:
-            collector.observe_staleness(pod, tag, lag)
+            collector.observe_staleness(pod, tag, lag, self.shard)
 
     # -- read side -----------------------------------------------------------
     def events_behind(self) -> dict[str, int]:
@@ -173,7 +179,7 @@ class StalenessTracker:
                 for pod, seq in self._received.items()
             }
         for pod, behind in out.items():
-            collector.set_events_behind(pod, behind)
+            collector.set_events_behind(pod, behind, self.shard)
         return out
 
     def percentiles(self, qs=(0.5, 0.99)) -> dict[str, Optional[float]]:
@@ -212,6 +218,66 @@ class StalenessTracker:
         return {
             "bucket_bounds_s": list(STALENESS_BUCKETS),
             "per_pod_event": per,
+            **self.snapshot(),
+        }
+
+
+class MergedStaleness:
+    """Read-side view over the sharded plane's per-shard trackers: the
+    same ``events_behind``/``percentiles``/``snapshot``/``detail`` surface
+    a single ``StalenessTracker`` offers, aggregated. Per-pod events-behind
+    is the MAX across shard lanes (one event pending on three shards is
+    one event behind, on the worst lane) PLUS the plane's admission-edge
+    backlog (``admission``: batches admitted but not yet decoded/split —
+    a lane's received high-water only advances at dispatch, so a drowning
+    decode stage would otherwise read as quiet lanes); lag percentiles
+    pool every shard's samples."""
+
+    def __init__(
+        self,
+        trackers: Sequence[StalenessTracker],
+        admission: Optional[Callable[[], dict]] = None,
+    ):
+        self.trackers = list(trackers)
+        self.admission = admission
+
+    def events_behind(self) -> dict[str, int]:
+        merged: dict[str, int] = {}
+        for t in self.trackers:
+            for pod, behind in t.events_behind().items():
+                merged[pod] = max(merged.get(pod, 0), behind)
+        if self.admission is not None:
+            for pod, behind in self.admission().items():
+                merged[pod] = merged.get(pod, 0) + behind
+                # the plane-level total rides the "" shard series (the
+                # per-lane series carry their own shard labels)
+                collector.set_events_behind(pod, merged[pod], "")
+        return merged
+
+    def _all_samples(self) -> list[float]:
+        samples: list[float] = []
+        for t in self.trackers:
+            with t._mu:
+                samples.extend(t._samples)
+        return samples
+
+    def percentiles(self, qs=(0.5, 0.99)) -> dict[str, Optional[float]]:
+        samples = self._all_samples()
+        return {f"p{int(q * 100)}": _percentile(samples, q) for q in qs}
+
+    def snapshot(self) -> dict:
+        samples = self._all_samples()
+        return {
+            "events_observed": sum(t.events_observed for t in self.trackers),
+            "max_lag_s": round(max((t.max_lag_s for t in self.trackers), default=0.0), 6),
+            "p50_lag_s": _percentile(samples, 0.5),
+            "p99_lag_s": _percentile(samples, 0.99),
+            "events_behind": self.events_behind(),
+        }
+
+    def detail(self) -> dict:
+        return {
+            "shards": {t.shard: t.detail() for t in self.trackers},
             **self.snapshot(),
         }
 

@@ -24,7 +24,7 @@ import asyncio
 import json
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from aiohttp import web
@@ -102,6 +102,15 @@ class ServiceConfig:
     #: gauges and an ``obs`` block on ``/stats``. Off (default) keeps the
     #: legacy ``/stats`` field set.
     obs_metrics: bool = False
+    #: sharded control plane (PR 11): partition the block index by chain
+    #: hash across this many scorer shards — per-shard event-apply workers
+    #: (no cross-shard lock on ingest) and score reads fanned out across
+    #: shards and merged. 0 (default) = the single-index legacy plane,
+    #: bit-identical responses, /stats fields, and wire bytes.
+    scorer_shards: int = 0
+    #: virtual nodes per shard on the consistent-hash ring (sizing: higher
+    #: = smoother balance and smaller resize movement, more ring memory)
+    scorer_shard_vnodes: int = 64
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -126,6 +135,8 @@ class ServiceConfig:
             obs_audit_ring=int(env.get("OBS_AUDIT_RING", "2048")),
             obs_metrics=env.get("OBS_METRICS", "").strip().lower()
             in ("1", "true", "yes", "on"),
+            scorer_shards=int(env.get("SCORER_SHARDS", "0")),
+            scorer_shard_vnodes=int(env.get("SCORER_SHARD_VNODES", "64")),
         )
 
 
@@ -159,15 +170,65 @@ class ScoringService:
             metrics_logging_interval=cfg.metrics_logging_interval,
         )
 
+    def _build_sharded_index(self, cfg: "ServiceConfig"):
+        """SCORER_SHARDS plane: N independent backend sub-indexes behind
+        the chain-hash facade. Metrics instrumentation wraps the FACADE
+        (one logical read = one lookup metric, as on a single index); the
+        events plane accounts its applies itself."""
+        import dataclasses
+
+        from ..kvcache.kvblock import InstrumentedIndex, create_index
+        from ..kvcache.sharding import ShardedIndex
+
+        base = self._index_config(cfg)
+        shard_cfg = dataclasses.replace(
+            base, enable_metrics=False, metrics_logging_interval=0.0
+        )
+        if shard_cfg.native_memory is not None:
+            # Native shards share ONE intern table, which is what lets the
+            # facade serve score fan-outs in a single C call (shared locks
+            # inside, no Python lock).
+            from ..kvcache.kvblock.native_memory import NativeMemoryIndex
+
+            shards = NativeMemoryIndex.shard_group(
+                cfg.scorer_shards, shard_cfg.native_memory
+            )
+        else:
+            shards = [create_index(shard_cfg) for _ in range(cfg.scorer_shards)]
+        self.sharded_index = ShardedIndex(
+            shards, vnodes=cfg.scorer_shard_vnodes
+        )
+        log.info(
+            "sharded control plane enabled",
+            shards=cfg.scorer_shards,
+            vnodes=cfg.scorer_shard_vnodes,
+        )
+        index = self.sharded_index
+        if cfg.enable_metrics:
+            collector.register()
+            index = InstrumentedIndex(index)
+            if cfg.metrics_logging_interval > 0:
+                collector.start_metrics_logging(cfg.metrics_logging_interval)
+        return index
+
     def __init__(self, config: Optional[ServiceConfig] = None, *, tokenizer=None):
         self.config = config or ServiceConfig()
         cfg = self.config
 
-        from ..kvcache.kvblock import IndexConfig
-
         # Fleet health is always attached (observation is free); expiry +
         # sweeping only activate when POD_TTL_S > 0.
         self.fleet_health = FleetHealth(FleetHealthConfig(pod_ttl_s=cfg.pod_ttl_s))
+        #: SCORER_SHARDS: the raw chain-hash-partitioned facade (None on
+        #: the legacy single-index plane). The indexer may additionally see
+        #: it through the instrumented decorator; the events plane applies
+        #: to the raw sub-indexes.
+        self.sharded_index = None
+        #: last scrape's per-shard occupancy (written by the gauge refresh,
+        #: read by the /stats sharding block — one walk per scrape)
+        self._last_shard_sizes = None
+        index = None
+        if cfg.scorer_shards > 0:
+            index = self._build_sharded_index(cfg)
         self.indexer = KVCacheIndexer(
             KVCacheIndexerConfig(
                 token_processor=TokenProcessorConfig(
@@ -178,6 +239,7 @@ class ScoringService:
                     hf_tokenizer=HFTokenizerConfig(huggingface_token=cfg.hf_token)
                 ),
             ),
+            index=index,
             tokenizer=tokenizer,
             fleet_health=self.fleet_health,
         )
@@ -185,14 +247,23 @@ class ScoringService:
         #: staleness tracker rides event ingest whenever either surface
         #: wants it (events-behind needs the seq high-waters); the route
         #: auditor only with the audit knob. None (default) = the pool
-        #: runs bit-identical legacy.
-        from ..obs.audit import RouteAuditor, StalenessTracker
+        #: runs bit-identical legacy. Under SCORER_SHARDS each shard lane
+        #: gets its own tracker (shard-labeled gauges) and ``staleness``
+        #: becomes the merged read view over them.
+        from ..obs.audit import MergedStaleness, RouteAuditor, StalenessTracker
 
-        self.staleness = (
-            StalenessTracker()
-            if (cfg.obs_audit or cfg.obs_metrics)
-            else None
-        )
+        self._shard_staleness = None
+        if cfg.obs_audit or cfg.obs_metrics:
+            if self.sharded_index is not None:
+                self._shard_staleness = [
+                    StalenessTracker(shard=str(i))
+                    for i in range(cfg.scorer_shards)
+                ]
+                self.staleness = MergedStaleness(self._shard_staleness)
+            else:
+                self.staleness = StalenessTracker()
+        else:
+            self.staleness = None
         self.route_auditor = (
             RouteAuditor(
                 index=self.indexer.kv_block_index,
@@ -202,13 +273,33 @@ class ScoringService:
             if cfg.obs_audit
             else None
         )
-        self.events_pool = KVEventsPool(
-            self.indexer.kv_block_index,
-            KVEventsPoolConfig(concurrency=cfg.pool_concurrency),
-            health=self.fleet_health,
-            staleness=self.staleness,
-            audit=self.route_auditor,
-        )
+        if self.sharded_index is not None:
+            from ..kvcache.sharding import (
+                ShardedEventsPool,
+                ShardedEventsPoolConfig,
+            )
+
+            self.events_pool = ShardedEventsPool(
+                self.sharded_index,
+                ShardedEventsPoolConfig(dispatchers=cfg.pool_concurrency),
+                health=self.fleet_health,
+                staleness=self._shard_staleness,
+                audit=self.route_auditor,
+                instrument=cfg.enable_metrics,
+            )
+            if isinstance(self.staleness, MergedStaleness):
+                # Fold the plane's admission-edge backlog (batches queued
+                # ahead of decode) into the events-behind view — per-shard
+                # lane trackers only see work after dispatch.
+                self.staleness.admission = self.events_pool.admission_behind
+        else:
+            self.events_pool = KVEventsPool(
+                self.indexer.kv_block_index,
+                KVEventsPoolConfig(concurrency=cfg.pool_concurrency),
+                health=self.fleet_health,
+                staleness=self.staleness,
+                audit=self.route_auditor,
+            )
         self.subscriber = ZMQSubscriber(
             self.events_pool,
             ZMQSubscriberConfig(endpoint=cfg.zmq_endpoint, topic_filter=cfg.zmq_topic),
@@ -434,7 +525,33 @@ class ScoringService:
         answer cheaply, e.g. Redis). The walk is O(index keys) — callers
         on the event loop must push it to the executor."""
         try:
-            info = self.indexer.kv_block_index.size_info()
+            if self.sharded_index is not None:
+                # ONE per-shard walk per scrape feeds everything: the
+                # shard-labeled gauges (where the keys actually live), the
+                # truthful aggregate (blocks summed over disjoint ranges,
+                # pods unioned), and the /stats sharding block (which
+                # reads the stashed snapshot instead of re-walking).
+                per = self.sharded_index.per_shard_size_info()
+                self._last_shard_sizes = per
+                for i, p in enumerate(per):
+                    if p is not None:
+                        collector.set_shard_index_size(
+                            str(i), p["blocks"], p["pods"]
+                        )
+                if any(p is None for p in per):
+                    info = None
+                else:
+                    names = self.sharded_index.pod_names()
+                    info = {
+                        "blocks": sum(p["blocks"] for p in per),
+                        "pods": (
+                            len(names)
+                            if names is not None
+                            else max((p["pods"] for p in per), default=0)
+                        ),
+                    }
+            else:
+                info = self.indexer.kv_block_index.size_info()
         except Exception:
             log.exception("index size_info failed")
             return None
@@ -499,6 +616,17 @@ class ScoringService:
             payload["staleness"] = self.staleness.snapshot()
         if self.route_auditor is not None:
             payload["audit"] = self.route_auditor.snapshot()
+        if self.sharded_index is not None:
+            # Gated on SCORER_SHARDS: the knobs-off /stats payload keeps
+            # its legacy field set bit-identical. The per-shard occupancy
+            # is the snapshot the gauge refresh above just walked — one
+            # O(shards) walk per scrape, not two.
+            payload["sharding"] = {
+                "shards": self.sharded_index.n_shards,
+                "vnodes": self.sharded_index.ring.vnodes,
+                "misroutes": self.events_pool.misroute_snapshot(),
+                "per_shard_index": self._last_shard_sizes,
+            }
         return web.json_response(payload)
 
     async def handle_debug_traces(self, request: web.Request) -> web.Response:

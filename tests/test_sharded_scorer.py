@@ -1,0 +1,1083 @@
+"""Sharded control plane suite (ISSUE 11 acceptance).
+
+The scoring service's block index is partitioned by chain hash across N
+scorer shards behind two facades (``ShardedIndex`` over the ``Index`` ABC,
+``ShardedEventsPool`` over the pool contract). Pinned here:
+
+- **Ring**: deterministic ownership, total coverage, rough balance, and
+  the consistent-hashing resize property (a new ring moves a minority of
+  keys, not the whole space).
+- **Conformance**: the existing backend-agnostic ``Index`` suite runs
+  through ``ShardedIndex`` over all five backends unchanged.
+- **Score equivalence**: randomized chains score identically through the
+  sharded fan-out and a single index (the hard read-path contract).
+- **Ingest plane**: per-(pod, shard) ordering, snapshot replace-all split
+  by range, PodDrained reaching every shard, health/audit observations,
+  and byte-for-byte the same wire payloads a single pool consumes.
+- **Misroutes**: an event op landing on a stale-ring shard is forwarded
+  once to the current owner (counted, rate-limit WARNed), never dropped.
+- **Chaos**: killing one shard leaves siblings scoring; a PR 3 snapshot
+  resync repairs the dead shard while sibling content stays put.
+- **Service**: ``SCORER_SHARDS`` unset keeps the legacy plane and the
+  pinned ``/stats`` key set; set, the sharded plane serves the same
+  scoreboards and ``/stats`` grows a gated ``sharding`` block.
+- **Fleet acceptance**: the 2-pod warm-route predicted==realized audit
+  join passes with a 4-shard control plane (real engines, real event
+  wire).
+"""
+
+import asyncio
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from chaos import ChaosLink
+from llm_d_kv_cache_manager_tpu.kvcache import (
+    BlendedRouter,
+    HashRing,
+    KVCacheIndexer,
+    KVCacheIndexerConfig,
+    PrefixAffinityTracker,
+    ShardedEventsPool,
+    ShardedEventsPoolConfig,
+    ShardedIndex,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    ChunkedTokenDatabase,
+    DeviceTier,
+    InMemoryIndex,
+    Key,
+    PodEntry,
+    TokenProcessorConfig,
+    native_available,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    BlockRemoved,
+    BlockStored,
+    EventBatch,
+    FleetHealth,
+    FleetHealthConfig,
+    Heartbeat,
+    IndexSnapshot,
+    KVEventsPool,
+    KVEventsPoolConfig,
+    Message,
+    PodDrained,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.scorer import LongestPrefixScorer
+from llm_d_kv_cache_manager_tpu.kvcache.sharding import _ShardTask
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
+from llm_d_kv_cache_manager_tpu.obs.audit import (
+    MergedStaleness,
+    RouteAuditor,
+    StalenessTracker,
+)
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    EngineConfig,
+    SamplingParams,
+    SchedulerConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.serve import PodServer, PodServerConfig
+
+from test_index_backends import BACKENDS
+from test_index_backends import TestIndexConformance as _IndexConformance
+
+PS = 4
+MODEL = "tiny-llama"
+
+
+def _keys(hashes, model=MODEL):
+    return [Key(model_name=model, chunk_hash=h) for h in hashes]
+
+
+def _entries(pods, tier=DeviceTier.TPU_HBM):
+    return [PodEntry(pod_identifier=p, device_tier=tier) for p in pods]
+
+
+def _msg(pod, events, seq, ts=0.0, model=MODEL):
+    return Message(
+        topic=f"kv@{pod}@{model}",
+        pod_identifier=pod,
+        model_name=model,
+        payload=EventBatch(ts=ts, events=events).to_payload(),
+        seq=seq,
+    )
+
+
+def _spread_hashes(rng, n):
+    """Uniform uint64 hashes (what real chain hashes look like on the ring)."""
+    return [rng.getrandbits(64) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Ring
+# ---------------------------------------------------------------------------
+
+
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        a, b = HashRing(4), HashRing(4)
+        rng = random.Random(0)
+        for h in _spread_hashes(rng, 200):
+            assert a.owner(h) == b.owner(h)
+
+    def test_total_coverage_and_rough_balance(self):
+        ring = HashRing(4)
+        rng = random.Random(1)
+        spread = ring.spread(_spread_hashes(rng, 20_000))
+        assert set(spread) == {0, 1, 2, 3}
+        # 64 vnodes/shard: every shard within ~2.5x of fair share
+        assert min(spread.values()) > 20_000 / 4 / 2.5
+        assert max(spread.values()) < 20_000 / 4 * 2.5
+
+    def test_resize_moves_a_minority_of_keys(self):
+        """The consistent-hashing property the misroute path exists for:
+        growing 4 → 5 shards reassigns roughly 1/5 of keys, not all."""
+        rng = random.Random(2)
+        hashes = _spread_hashes(rng, 10_000)
+        old, new = HashRing(4), HashRing(5)
+        moved = sum(1 for h in hashes if old.owner(h) != new.owner(h))
+        assert 0 < moved < 0.45 * len(hashes)
+
+    def test_invalid_params_raise(self):
+        with pytest.raises(ValueError):
+            HashRing(0)
+        with pytest.raises(ValueError):
+            HashRing(2, vnodes=0)
+
+    def test_facade_rejects_mismatched_ring(self):
+        idx = ShardedIndex([InMemoryIndex() for _ in range(2)])
+        with pytest.raises(ValueError):
+            idx.set_ring(HashRing(3))
+        with pytest.raises(ValueError):
+            ShardedIndex([InMemoryIndex()], ring=HashRing(2))
+
+
+# ---------------------------------------------------------------------------
+# Conformance: the existing Index suite through the facade, all backends
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=list(BACKENDS))
+def index(request):
+    make = BACKENDS[request.param]
+    # 3 shards (odd, non-power-of-two) with a small ring: conformance keys
+    # are tiny ints, so a coarse ring still splits them across shards.
+    return ShardedIndex([make() for _ in range(3)], vnodes=16)
+
+
+class TestShardedConformance(_IndexConformance):
+    """The whole backend-agnostic suite, re-run with every backend behind
+    the chain-hash facade (the ``index`` fixture above shadows the
+    original module's)."""
+
+
+# ---------------------------------------------------------------------------
+# Facade semantics
+# ---------------------------------------------------------------------------
+
+
+class TestShardedIndexSemantics:
+    def test_score_equivalence_random(self):
+        """Sharded fan-out + merge must score EXACTLY like lookup → scorer
+        on one index, over random chains/pods/holes/filters."""
+        rng = random.Random(7)
+        scorer = LongestPrefixScorer()
+        for trial in range(40):
+            n_shards = rng.choice([2, 3, 5])
+            sharded = ShardedIndex(
+                [InMemoryIndex() for _ in range(n_shards)], vnodes=8
+            )
+            single = InMemoryIndex()
+            chain = _spread_hashes(rng, rng.randint(1, 12))
+            keys = _keys(chain)
+            for pod in ("pa", "pb", "pc"):
+                depth = rng.randint(0, len(keys))
+                sub = [k for k in keys[:depth] if rng.random() > 0.2]
+                if not sub:
+                    continue
+                for idx in (sharded, single):
+                    idx.add(sub, _entries([pod]))
+            pf = rng.choice([None, {"pa"}, {"pa", "pb"}, {"zz"}])
+            expected = scorer.score(keys, single.lookup(keys, pf))
+            assert sharded.score_longest_prefix(keys, pf) == expected, trial
+            scores, _hits = sharded.score_hashes_with_hits(MODEL, chain, pf)
+            assert scores == expected, trial
+
+    def test_score_hits_count_matches_lookup_semantics(self):
+        sharded = ShardedIndex([InMemoryIndex() for _ in range(3)], vnodes=8)
+        chain = _spread_hashes(random.Random(8), 10)
+        keys = _keys(chain)
+        stored = keys[:2] + keys[3:]  # hole at position 2
+        sharded.add(stored, _entries(["pa"]))
+        scores, hits = sharded.score_hashes_with_hits(MODEL, chain, None)
+        assert scores == {"pa": 2}  # streak dies at the hole
+        assert hits == 9  # but 9 of 10 positions held pods
+
+    def test_mixed_model_chains_fall_back(self):
+        sharded = ShardedIndex([InMemoryIndex() for _ in range(2)])
+        sharded.add([Key("m1", 1)], _entries(["pa"]))
+        assert (
+            sharded.score_longest_prefix([Key("m1", 1), Key("m2", 1)], None)
+            is None
+        )
+
+    def test_empty_inputs(self):
+        sharded = ShardedIndex([InMemoryIndex() for _ in range(2)])
+        assert sharded.score_hashes_with_hits(MODEL, [], None) == ({}, 0)
+        assert sharded.score_longest_prefix_with_hits([], None) == ({}, 0)
+        with pytest.raises(ValueError):
+            sharded.lookup([])
+        with pytest.raises(ValueError):
+            sharded.add([], _entries(["pa"]))
+
+    def test_size_info_aggregates_blocks_and_unions_pods(self):
+        sharded = ShardedIndex([InMemoryIndex() for _ in range(4)], vnodes=8)
+        rng = random.Random(9)
+        keys_a = _keys(_spread_hashes(rng, 16))
+        keys_b = _keys(_spread_hashes(rng, 8))
+        sharded.add(keys_a, _entries(["pa"]))
+        sharded.add(keys_b, _entries(["pb"]))
+        info = sharded.size_info()
+        # blocks sum exactly (disjoint ranges); pods UNION across shards —
+        # each pod holds keys on several shards but counts once.
+        assert info == {"blocks": 24, "pods": 2}
+        per = sharded.per_shard_size_info()
+        assert sum(p["blocks"] for p in per) == 24
+        assert sorted(sharded.pod_names()) == ["pa", "pb"]
+
+    def test_indexer_composes_with_sharded_index(self):
+        """``KVCacheIndexer`` over the facade: fused discovery picks the
+        fan-out read path and scoreboards match the single-index run."""
+        tp = TokenProcessorConfig(block_size=PS)
+        tokens = list(range(32))
+        sharded_ix = KVCacheIndexer(
+            KVCacheIndexerConfig(token_processor=tp),
+            index=ShardedIndex([InMemoryIndex() for _ in range(4)], vnodes=8),
+        )
+        single_ix = KVCacheIndexer(KVCacheIndexerConfig(token_processor=tp))
+        assert sharded_ix._fused_hash_score is not None
+        hashes = sharded_ix.token_processor.prefix_hashes(tokens)
+        sharded_ix.kv_block_index.add(_keys(hashes), _entries(["pa", "pb"]))
+        single_ix.kv_block_index.add(_keys(hashes), _entries(["pa", "pb"]))
+        assert sharded_ix.score_tokens(tokens, MODEL) == single_ix.score_tokens(
+            tokens, MODEL
+        )
+
+    def test_replace_shard_swaps_only_that_range(self):
+        sharded = ShardedIndex([InMemoryIndex() for _ in range(3)], vnodes=8)
+        keys = _keys(_spread_hashes(random.Random(10), 30))
+        sharded.add(keys, _entries(["pa"]))
+        dead = 1
+        sharded.replace_shard(dead, InMemoryIndex())
+        got = sharded.lookup(keys, set())
+        for k in keys:
+            if sharded.owner(k.chunk_hash) == dead:
+                assert k not in got
+            else:
+                assert got[k] == ["pa"]
+
+
+# ---------------------------------------------------------------------------
+# Native read-side path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not native_available(), reason="liblruindex.so not built")
+class TestNativeReadSide:
+    def _native(self, **kw):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+            NativeMemoryIndex,
+            NativeMemoryIndexConfig,
+        )
+
+        return NativeMemoryIndex(NativeMemoryIndexConfig(**kw))
+
+    def test_lookup_ro_matches_lookup(self):
+        idx = self._native(size=100, pod_cache_size=4)
+        rng = random.Random(11)
+        chain = _spread_hashes(rng, 12)
+        keys = _keys(chain)
+        idx.add(keys[:8], _entries(["pa", "pb"]))
+        processed, per = idx.lookup_hashes_ro(MODEL, chain)
+        assert processed == 12
+        two_step = idx.lookup(keys, set())
+        for k, pods in zip(keys, per):
+            assert sorted(pods) == sorted(two_step.get(k, []))
+
+    def test_lookup_ro_does_not_promote_recency(self):
+        idx = self._native(size=2, pod_cache_size=4)
+        k1, k2, k3 = _keys([1, 2, 3])
+        idx.add([k1, k2], _entries(["pa"]))  # recency: k2 > k1
+        # RO read of k1 must NOT promote it...
+        idx.lookup_hashes_ro(MODEL, [k1.chunk_hash])
+        idx.add([k3], _entries(["pa"]))  # ...so k1 (still LRU) is evicted
+        got = idx.lookup([k1, k2, k3], set())
+        assert k1 not in got and k2 in got and k3 in got
+
+    def test_lookup_ro_early_stop_on_empty_key(self):
+        idx = self._native(size=10, pod_cache_size=4)
+        keys = _keys([1, 2, 3])
+        idx.add(keys, _entries(["pa"]))
+        idx.add([keys[1]], _entries(["pb"]))
+        idx.evict(keys[1], _entries(["pa"]))
+        idx.evict(keys[1], _entries(["pb"]))  # key 2 emptied → removed
+        processed, _per = idx.lookup_hashes_ro(MODEL, [k.chunk_hash for k in keys])
+        assert processed == 3  # removed key = missing: walk continues
+
+    def test_lookup_ro_unknown_model_and_filter(self):
+        idx = self._native(size=10, pod_cache_size=4)
+        processed, per = idx.lookup_hashes_ro("never-seen", [1, 2])
+        assert processed == 2 and per == [[], []]
+        idx.add(_keys([5]), _entries(["pa"]))
+        _p, per = idx.lookup_hashes_ro(MODEL, [5], {"pz"})
+        assert per == [[]]
+
+    def test_shard_group_fused_scoring_matches_merge_and_single(self):
+        """The one-C-call fan (shard_group: shared interns) must score
+        exactly like the Python merge path AND a single index, over random
+        chains/pods/holes/filters."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+            NativeMemoryIndex,
+            NativeMemoryIndexConfig,
+        )
+
+        rng = random.Random(13)
+        scorer = LongestPrefixScorer()
+        for trial in range(25):
+            cfg = NativeMemoryIndexConfig(size=256, pod_cache_size=8)
+            sharded = ShardedIndex(
+                NativeMemoryIndex.shard_group(4, cfg), vnodes=8
+            )
+            assert sharded._fan_lrus is not None  # fused fan detected
+            independent = ShardedIndex(
+                [NativeMemoryIndex(cfg) for _ in range(4)], ring=sharded.ring
+            )
+            assert independent._fan_lrus is None  # unshared interns: merge
+            single = InMemoryIndex()
+            chain = _spread_hashes(rng, rng.randint(1, 12))
+            keys = _keys(chain)
+            for pod in ("pa", "pb", "pc"):
+                depth = rng.randint(0, len(keys))
+                sub = [k for k in keys[:depth] if rng.random() > 0.2]
+                if not sub:
+                    continue
+                for idx in (sharded, independent, single):
+                    idx.add(sub, _entries([pod]))
+            pf = rng.choice([None, {"pa"}, {"pa", "pb"}, {"zz"}])
+            expected = scorer.score(keys, single.lookup(keys, pf))
+            fused = sharded.score_hashes_with_hits(MODEL, chain, pf)
+            merged = independent.score_hashes_with_hits(MODEL, chain, pf)
+            assert fused[0] == expected, trial
+            assert fused == merged, trial
+
+    def test_shard_group_replace_shard_disables_then_reenables_fan(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+            NativeMemoryIndex,
+            NativeMemoryIndexConfig,
+        )
+
+        cfg = NativeMemoryIndexConfig(size=64, pod_cache_size=4)
+        group = NativeMemoryIndex.shard_group(2, cfg)
+        sharded = ShardedIndex(group, vnodes=8)
+        assert sharded._fan_lrus is not None
+        # a restarted replica sharing the group store keeps the fan ...
+        sharded.replace_shard(
+            1, NativeMemoryIndex(cfg, interns=group[0]._interns)
+        )
+        assert sharded._fan_lrus is not None
+        # ... a foreign backend drops to the merge path (still correct)
+        sharded.replace_shard(1, InMemoryIndex())
+        assert sharded._fan_lrus is None
+        keys = _keys(_spread_hashes(random.Random(14), 8))
+        sharded.add(keys, _entries(["pa"]))
+        assert sharded.score_longest_prefix(keys, None) == {"pa": 8}
+
+    def test_shard_group_per_shard_pod_occupancy_is_exact(self):
+        """With a shared intern table, per-shard pods must come from the C
+        occupancy walk, NOT the group-wide ever-interned count — otherwise
+        every shard's gauge reads identically flat."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+            NativeMemoryIndex,
+            NativeMemoryIndexConfig,
+        )
+
+        group = NativeMemoryIndex.shard_group(
+            2, NativeMemoryIndexConfig(size=64, pod_cache_size=4)
+        )
+        sharded = ShardedIndex(group, vnodes=8)
+        rng = random.Random(15)
+        # pa on both shards; pb only where its keys land
+        all_keys = _keys(_spread_hashes(rng, 24))
+        sharded.add(all_keys, _entries(["pa"]))
+        pb_shard0 = [k for k in all_keys if sharded.owner(k.chunk_hash) == 0][:4]
+        sharded.add(pb_shard0, _entries(["pb"]))
+        per = sharded.per_shard_size_info()
+        assert per[0]["pods"] == 2 and per[1]["pods"] == 1, per
+        assert group[1].pod_names() == ["pa"]  # exact, not ever-interned
+        assert sharded.size_info()["pods"] == 2
+        # eviction decreases occupancy (the interned superset never would)
+        sharded.evict_pod("pb")
+        assert sharded.per_shard_size_info()[0]["pods"] == 1
+        assert sharded.size_info()["pods"] == 1
+
+    def test_concurrent_ro_reads_during_apply(self):
+        """The lock-free read contract: fan-out reads racing adds/evicts/
+        sweeps never error and always return a consistent name list."""
+        idx = self._native(size=512, pod_cache_size=8)
+        rng = random.Random(12)
+        chain = _spread_hashes(rng, 32)
+        idx.add(_keys(chain), _entries(["p0"]))
+        errors = []
+        stop = threading.Event()
+
+        def writer(tid):
+            try:
+                r = random.Random(tid)
+                for i in range(300):
+                    pod = f"p{r.randint(0, 5)}"
+                    sub = _keys(r.sample(chain, 8))
+                    if i % 7 == 0:
+                        idx.evict_pod(pod)
+                    elif i % 3 == 0:
+                        idx.evict(sub[0], _entries([pod]))
+                    else:
+                        idx.add(sub, _entries([pod]))
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    out = idx.lookup_hashes_ro(MODEL, chain)
+                    assert out is not None
+                    _processed, per = out
+                    for pods in per:
+                        assert all(isinstance(p, str) for p in pods)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        writers = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+        readers = [threading.Thread(target=reader) for _ in range(4)]
+        for t in writers + readers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in readers:
+            t.join()
+        assert not errors
+
+
+# ---------------------------------------------------------------------------
+# Ingest plane
+# ---------------------------------------------------------------------------
+
+
+def _plane(n_shards=4, dispatchers=2, **kw):
+    idx = ShardedIndex([InMemoryIndex() for _ in range(n_shards)], vnodes=8)
+    plane = ShardedEventsPool(
+        idx, ShardedEventsPoolConfig(dispatchers=dispatchers), **kw
+    )
+    return idx, plane
+
+
+class TestShardedEventsPool:
+    def test_same_wire_payloads_as_single_pool(self):
+        """Byte-identical wire in, semantically identical index out: the
+        sharded plane consumes the exact payloads the single pool does
+        (SCORER_SHARDS touches no wire format)."""
+        rng = random.Random(20)
+        chain = _spread_hashes(rng, 24)
+        single = InMemoryIndex()
+        pool = KVEventsPool(single, KVEventsPoolConfig(concurrency=2))
+        sharded, plane = _plane()
+        pool.start()
+        plane.start()
+        msgs = [
+            _msg("p1", [BlockStored(block_hashes=chain)], 1),
+            _msg("p1", [BlockRemoved(block_hashes=chain[5:8])], 2),
+            _msg("p2", [BlockStored(block_hashes=chain[:10])], 1),
+        ]
+        for m in msgs:
+            # identical bytes to both planes
+            pool.add_task(m)
+            plane.add_task(
+                Message(m.topic, m.pod_identifier, m.model_name, m.payload, m.seq)
+            )
+        assert pool.drain(5) and plane.drain(5)
+        pool.shutdown()
+        plane.shutdown()
+        keys = _keys(chain)
+        got_s, got_1 = sharded.lookup(keys, set()), single.lookup(keys, set())
+        # Per-key pod SETS (apply interleaving across the two pods' lanes
+        # makes the recency order nondeterministic in both planes).
+        assert {k: set(v) for k, v in got_s.items()} == {
+            k: set(v) for k, v in got_1.items()
+        }
+
+    def test_per_pod_order_add_then_evict_lands_evicted(self):
+        sharded, plane = _plane(dispatchers=1)
+        plane.start()
+        h = [7, 8, 9]
+        for i in range(25):  # add/evict churn, same key set, one pod lane
+            plane.add_task(_msg("p1", [BlockStored(block_hashes=h)], 2 * i))
+            plane.add_task(_msg("p1", [BlockRemoved(block_hashes=h)], 2 * i + 1))
+        assert plane.drain(5)
+        plane.shutdown()
+        assert sharded.lookup(_keys(h), set()) == {}
+
+    def test_snapshot_replace_all_split_by_range(self):
+        sharded, plane = _plane()
+        plane.start()
+        rng = random.Random(21)
+        old = _spread_hashes(rng, 16)
+        new = old[:4] + _spread_hashes(rng, 4)
+        plane.add_task(_msg("p1", [BlockStored(block_hashes=old)], 1))
+        assert plane.drain(5)
+        plane.add_task(
+            _msg("p1", [IndexSnapshot(blocks_by_medium={"tpu_hbm": new})], 2)
+        )
+        assert plane.drain(5)
+        plane.shutdown()
+        got = sharded.lookup(_keys(old + new), set())
+        assert set(got) == set(_keys(new))  # exactly the digest survives
+
+    def test_pod_drained_evicts_every_shard(self):
+        fh = FleetHealth(FleetHealthConfig())
+        sharded, plane = _plane(health=fh)
+        plane.start()
+        chain = _spread_hashes(random.Random(22), 16)
+        plane.add_task(_msg("p1", [BlockStored(block_hashes=chain)], 1))
+        plane.add_task(_msg("p2", [BlockStored(block_hashes=chain)], 1))
+        assert plane.drain(5)
+        plane.add_task(_msg("p1", [PodDrained()], 2))
+        assert plane.drain(5)
+        plane.shutdown()
+        got = sharded.lookup(_keys(chain), set())
+        assert all(got[k] == ["p2"] for k in _keys(chain))
+        assert not fh.is_routable("p1")
+
+    def test_health_and_audit_observed_once_per_message(self):
+        fh = FleetHealth(FleetHealthConfig())
+        auditor = RouteAuditor(model_name=MODEL)
+        sharded, plane = _plane(health=fh, audit=auditor)
+        plane.start()
+        auditor.record_decision(
+            "r1", chosen_pod="p1", predicted_blocks=2, scoreboard={"p1": 2}
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents import RequestAudit
+
+        plane.add_task(
+            _msg(
+                "p1",
+                [
+                    Heartbeat(dropped_batches=0),
+                    BlockStored(block_hashes=[1, 2, 3]),
+                    RequestAudit(request_id="r1", realized_blocks=2),
+                ],
+                1,
+            )
+        )
+        assert plane.drain(5)
+        plane.shutdown()
+        assert auditor.snapshot()["joined"] == 1
+        snap = fh.snapshot()
+        assert "p1" in snap["pods"]
+
+    def test_rejected_after_shutdown_counted(self):
+        sharded, plane = _plane()
+        plane.start()
+        plane.shutdown()
+        plane.add_task(_msg("p1", [BlockStored(block_hashes=[1])], 1))
+        assert plane.rejected_after_shutdown == 1
+        assert sharded.lookup(_keys([1]), set()) == {}
+
+    def test_poison_payload_never_kills_lane(self):
+        sharded, plane = _plane(dispatchers=1)
+        plane.start()
+        plane.add_task(
+            Message(topic="t", pod_identifier="p1", model_name=MODEL,
+                    payload=b"\x00garbage", seq=1)
+        )
+        plane.add_task(_msg("p1", [BlockStored(block_hashes=[42])], 2))
+        assert plane.drain(5)
+        plane.shutdown()
+        assert sharded.lookup(_keys([42]), set()) == {_keys([42])[0]: ["p1"]}
+
+    def test_per_shard_staleness_trackers(self):
+        now = [1000.0]
+        trackers = [
+            StalenessTracker(clock=lambda: now[0], shard=str(i))
+            for i in range(4)
+        ]
+        sharded, plane = _plane(staleness=trackers)
+        plane.start()
+        chain = _spread_hashes(random.Random(23), 32)
+        plane.add_task(_msg("p1", [BlockStored(block_hashes=chain)], 1, ts=999.0))
+        assert plane.drain(5)
+        plane.shutdown()
+        merged = MergedStaleness(trackers)
+        snap = merged.snapshot()
+        assert snap["events_observed"] > 0
+        assert snap["max_lag_s"] == pytest.approx(1.0)
+        # every lane applied its slice: no pod reads behind
+        assert merged.events_behind() == {"p1": 0}
+        detail = merged.detail()
+        assert set(detail["shards"]) == {"0", "1", "2", "3"}
+
+    def test_admission_backlog_visible_before_dispatch(self):
+        """Events-behind must see backlog queued AHEAD of the decode stage
+        (per-shard lane trackers only advance at dispatch)."""
+        trackers = [StalenessTracker(shard=str(i)) for i in range(4)]
+        sharded, plane = _plane(staleness=trackers)
+        merged = MergedStaleness(trackers, admission=plane.admission_behind)
+        # NOT started: admitted messages sit undecoded in dispatch queues.
+        for seq in (1, 2, 3):
+            plane.add_task(_msg("p1", [BlockStored(block_hashes=[seq])], seq))
+        assert plane.admission_behind() == {"p1": 3}
+        assert merged.events_behind() == {"p1": 3}
+        plane.start()
+        assert plane.drain(5)
+        assert plane.admission_behind() == {"p1": 0}
+        assert merged.events_behind() == {"p1": 0}
+        plane.shutdown()
+
+    def test_tracker_count_must_match_shards(self):
+        idx = ShardedIndex([InMemoryIndex() for _ in range(4)])
+        with pytest.raises(ValueError):
+            ShardedEventsPool(idx, staleness=[StalenessTracker()])
+
+
+class TestMisroute:
+    def test_stale_task_forwarded_once_and_counted(self):
+        """White-box: a task stamped with the wrong owner (what a stale
+        ring produces) is forwarded exactly once, applied on the right
+        shard, and counted — never dropped."""
+        sharded, plane = _plane(n_shards=2)
+        k = _keys([12345])[0]
+        owner = sharded.owner(k.chunk_hash)
+        wrong = 1 - owner
+        plane.start()
+        plane._shard_queues[wrong].put(
+            _ShardTask(
+                shard=wrong, pod="p1", model=MODEL, seq=1, ts=0.0,
+                tags=["BlockStored"],
+                ops=[("add", [k.chunk_hash], _entries(["p1"]))],
+            )
+        )
+        assert plane.drain(5)
+        plane.shutdown()
+        assert sharded.shards[owner].lookup([k], set()) == {k: ["p1"]}
+        assert sharded.shards[wrong].lookup([k], set()) == {}
+        snap = plane.misroute_snapshot()
+        assert snap["total"] == 1 and snap["by_shard"] == {wrong: 1}
+
+    def test_forwarded_task_applies_where_it_lands(self):
+        """Forward-once: a task already forwarded is applied locally even
+        if the ring moved again mid-flight (late locality beats a loop)."""
+        sharded, plane = _plane(n_shards=2)
+        k = _keys([54321])[0]
+        wrong = 1 - sharded.owner(k.chunk_hash)
+        plane.start()
+        plane._shard_queues[wrong].put(
+            _ShardTask(
+                shard=wrong, pod="p1", model=MODEL, seq=1, ts=0.0,
+                tags=["BlockStored"],
+                ops=[("add", [k.chunk_hash], _entries(["p1"]))],
+                forwarded=True,
+            )
+        )
+        assert plane.drain(5)
+        plane.shutdown()
+        assert sharded.shards[wrong].lookup([k], set()) == {k: ["p1"]}
+        assert plane.misroute_snapshot()["total"] == 0
+
+    def test_resize_inflight_events_converge_to_new_owners(self):
+        """Integration: events split under the old ring, applied under the
+        new one — every key converges to its CURRENT owner via the
+        forward-once path, and the misroute counter shows the move."""
+        idx = ShardedIndex([InMemoryIndex() for _ in range(4)], vnodes=4)
+        plane = ShardedEventsPool(idx, ShardedEventsPoolConfig(dispatchers=1))
+        chain = _spread_hashes(random.Random(24), 64)
+        # split/stamp under the OLD ring (workers not running yet) ...
+        plane._dispatch(_msg("p1", [BlockStored(block_hashes=chain)], 1))
+        # ... resize ...
+        idx.set_ring(HashRing(4, vnodes=32))
+        # ... then apply under the NEW ring.
+        plane.start()
+        assert plane.drain(5)
+        plane.shutdown()
+        for h in chain:
+            k = _keys([h])[0]
+            assert idx.shards[idx.owner(h)].lookup([k], set()) == {k: ["p1"]}
+        moved = plane.misroute_snapshot()["total"]
+        assert 0 < moved < len(chain)  # a minority moved — and none dropped
+
+    def test_evict_misroute_forwarded(self):
+        sharded, plane = _plane(n_shards=2)
+        k = _keys([999])[0]
+        owner = sharded.owner(k.chunk_hash)
+        sharded.shards[owner].add([k], _entries(["p1"]))
+        wrong = 1 - owner
+        plane.start()
+        plane._shard_queues[wrong].put(
+            _ShardTask(
+                shard=wrong, pod="p1", model=MODEL, seq=1, ts=0.0,
+                tags=["BlockRemoved"],
+                ops=[("evict", k.chunk_hash, _entries(["p1"]))],
+            )
+        )
+        assert plane.drain(5)
+        plane.shutdown()
+        assert sharded.shards[owner].lookup([k], set()) == {}
+        assert plane.misroute_snapshot()["total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Chaos: shard loss + resync repair
+# ---------------------------------------------------------------------------
+
+
+class TestShardChaos:
+    def test_kill_shard_siblings_keep_scoring_resync_repairs(self):
+        sharded, plane = _plane(n_shards=4)
+        plane.start()
+        rng = random.Random(30)
+        chain = _spread_hashes(rng, 32)
+        keys = _keys(chain)
+        plane.add_task(_msg("p1", [BlockStored(block_hashes=chain)], 1))
+        assert plane.drain(5)
+        assert sharded.score_hashes(MODEL, chain) == {"p1": 32}
+
+        dead = sharded.owner(chain[-1])
+        sibling_keys = [k for k in keys if sharded.owner(k.chunk_hash) != dead]
+        before = {
+            s: sharded.shards[s].lookup(
+                [k for k in keys if sharded.owner(k.chunk_hash) == s], set()
+            )
+            for s in range(4)
+            if s != dead and any(sharded.owner(k.chunk_hash) == s for k in keys)
+        }
+
+        # Kill: the shard replica restarts empty.
+        sharded.replace_shard(dead, InMemoryIndex())
+        # Siblings keep scoring (and sweeping) without the dead shard.
+        got = sharded.lookup(keys, set())
+        assert set(got) == set(sibling_keys)
+        assert all(got[k] == ["p1"] for k in sibling_keys)
+
+        # PR 3 resync: the pod's snapshot repairs the dead shard's range;
+        # sibling shard content is semantically untouched.
+        plane.add_task(
+            _msg("p1", [IndexSnapshot(blocks_by_medium={"tpu_hbm": chain})], 2)
+        )
+        assert plane.drain(5)
+        plane.shutdown()
+        assert sharded.score_hashes(MODEL, chain) == {"p1": 32}
+        after = {
+            s: sharded.shards[s].lookup(
+                [k for k in keys if sharded.owner(k.chunk_hash) == s], set()
+            )
+            for s in before
+        }
+        assert after == before
+        assert plane.misroute_snapshot()["total"] == 0
+
+    def test_scoring_during_dead_window_prefix_semantics(self):
+        """With the shard owning position 0 dead, the streak starts empty —
+        the facade degrades exactly like a single index that lost those
+        keys, never erroring."""
+        sharded, plane = _plane(n_shards=4)
+        chain = _spread_hashes(random.Random(31), 16)
+        sharded.add(_keys(chain), _entries(["p1"]))
+        dead = sharded.owner(chain[0])
+        sharded.replace_shard(dead, InMemoryIndex())
+        scores = sharded.score_hashes(MODEL, chain)
+        assert scores == {} or "p1" in scores  # no error, honest prefix
+
+
+# ---------------------------------------------------------------------------
+# Concurrency hammer (runs under LOCKTRACE=1 in CI)
+# ---------------------------------------------------------------------------
+
+
+class TestShardedHammer:
+    def test_concurrent_ingest_reads_and_sweeps(self):
+        sharded, plane = _plane(n_shards=4, dispatchers=2)
+        plane.start()
+        rng = random.Random(40)
+        chain = _spread_hashes(rng, 64)
+        errors = []
+        stop = threading.Event()
+
+        def ingester(tid):
+            try:
+                r = random.Random(tid)
+                for i in range(60):
+                    pod = f"p{tid}"
+                    sub = r.sample(chain, 8)
+                    plane.add_task(_msg(pod, [BlockStored(block_hashes=sub)], i))
+                    if i % 5 == 0:
+                        plane.add_task(
+                            _msg(pod, [BlockRemoved(block_hashes=sub[:2])], i + 1000)
+                        )
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    sharded.score_hashes(MODEL, chain)
+                    sharded.lookup(_keys(chain), set())
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def sweeper():
+            try:
+                while not stop.is_set():
+                    sharded.evict_pod("p0")
+                    time.sleep(0.001)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=ingester, args=(t,)) for t in range(3)]
+        threads += [threading.Thread(target=reader) for _ in range(2)]
+        threads.append(threading.Thread(target=sweeper))
+        for t in threads:
+            t.start()
+        for t in threads[:3]:
+            t.join()
+        stop.set()
+        for t in threads[3:]:
+            t.join()
+        assert plane.drain(10)
+        plane.shutdown()
+        assert not errors
+
+
+# ---------------------------------------------------------------------------
+# Service wiring
+# ---------------------------------------------------------------------------
+
+
+class TestServiceSharding:
+    def _svc(self, **kw):
+        from llm_d_kv_cache_manager_tpu.server.api import (
+            ScoringService,
+            ServiceConfig,
+        )
+
+        return ScoringService(
+            ServiceConfig(native_index=False, enable_metrics=False, **kw)
+        )
+
+    def test_from_env_reads_shard_knobs(self, monkeypatch):
+        from llm_d_kv_cache_manager_tpu.server.api import ServiceConfig
+
+        monkeypatch.setenv("SCORER_SHARDS", "4")
+        monkeypatch.setenv("SCORER_SHARD_VNODES", "16")
+        cfg = ServiceConfig.from_env()
+        assert cfg.scorer_shards == 4 and cfg.scorer_shard_vnodes == 16
+        monkeypatch.delenv("SCORER_SHARDS")
+        monkeypatch.delenv("SCORER_SHARD_VNODES")
+        cfg = ServiceConfig.from_env()
+        assert cfg.scorer_shards == 0  # off by default
+
+    def test_knobs_off_legacy_plane_and_stats_pinned(self):
+        svc = self._svc()
+        assert svc.sharded_index is None
+        assert isinstance(svc.events_pool, KVEventsPool)
+
+        async def runner():
+            ts = TestServer(svc.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                stats = await (await client.get("/stats")).json()
+                # The PR 10 legacy pin, verbatim: no "sharding" key.
+                assert set(stats) == {
+                    "fleet", "subscriber", "events_rejected_after_shutdown",
+                    "index_size", "index",
+                }
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            svc.indexer.shutdown()
+
+    def test_sharded_service_scores_and_stats_block(self):
+        svc = self._svc(scorer_shards=4, block_size=PS)
+        assert isinstance(svc.events_pool, ShardedEventsPool)
+        svc.events_pool.start()
+        tokens = list(range(32))
+        hashes = svc.indexer.token_processor.prefix_hashes(tokens)
+        svc.events_pool.add_task(
+            _msg("p1", [BlockStored(block_hashes=hashes)], 1)
+        )
+        assert svc.events_pool.drain(5)
+        assert svc.indexer.score_tokens(tokens, MODEL) == {"p1": len(hashes)}
+
+        async def runner():
+            ts = TestServer(svc.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                stats = await (await client.get("/stats")).json()
+                assert stats["sharding"]["shards"] == 4
+                assert stats["sharding"]["misroutes"]["total"] == 0
+                per = stats["sharding"]["per_shard_index"]
+                assert sum(p["blocks"] for p in per) == len(hashes)
+                # the aggregate index_size stays truthful across shards
+                assert stats["index_size"] == {
+                    "blocks": len(hashes), "pods": 1,
+                }
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            svc.events_pool.shutdown()
+            svc.indexer.shutdown()
+
+    def test_sharded_vs_single_scoreboards_identical(self):
+        single = self._svc(block_size=PS)
+        sharded = self._svc(scorer_shards=3, block_size=PS)
+        for svc in (single, sharded):
+            svc.events_pool.start()
+        try:
+            rng = random.Random(50)
+            for pod in ("pa", "pb"):
+                tokens = list(range(rng.randint(8, 40)))
+                hashes = single.indexer.token_processor.prefix_hashes(tokens)
+                for svc in (single, sharded):
+                    svc.events_pool.add_task(
+                        _msg(pod, [BlockStored(block_hashes=hashes)], 1)
+                    )
+            for svc in (single, sharded):
+                assert svc.events_pool.drain(5)
+            probe = list(range(40))
+            assert single.indexer.score_tokens(
+                probe, MODEL
+            ) == sharded.indexer.score_tokens(probe, MODEL)
+        finally:
+            for svc in (single, sharded):
+                svc.events_pool.shutdown()
+                svc.indexer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fleet acceptance: warm route predicted == realized, 4-shard plane
+# ---------------------------------------------------------------------------
+
+
+def _engine_config(total_pages=64):
+    return EngineConfig(
+        model=TINY_LLAMA,
+        block_manager=BlockManagerConfig(total_pages=total_pages, page_size=PS),
+        scheduler=SchedulerConfig(max_prefill_batch=4),
+        max_model_len=64,
+        decode_batch_size=4,
+        prefill_bucket=8,
+        interpret=True,
+    )
+
+
+def _pod_config(pod_id, **kw):
+    return PodServerConfig(
+        model_name=MODEL,
+        pod_identifier=pod_id,
+        publish_events=kw.pop("publish_events", False),
+        engine=_engine_config(total_pages=kw.pop("total_pages", 64)),
+        **kw,
+    )
+
+
+def _prompt(seed, n):
+    return list(
+        map(int, np.random.default_rng(seed).integers(0, TINY_LLAMA.vocab_size, n))
+    )
+
+
+class TestShardedFleetAcceptance:
+    """The PR 10 2-pod acceptance — real engines, real event wire, the
+    audit join — with the control plane sharded 4 ways (``SCORER_SHARDS=4``
+    equivalent wiring): the warm route still predicts exactly what the pod
+    realizes."""
+
+    def test_warm_route_predicted_equals_realized_with_four_shards(self):
+        sharded = ShardedIndex([InMemoryIndex() for _ in range(4)], vnodes=16)
+        indexer = KVCacheIndexer(
+            KVCacheIndexerConfig(
+                token_processor=TokenProcessorConfig(block_size=PS)
+            ),
+            index=sharded,
+        )
+        fh = FleetHealth(FleetHealthConfig())
+        trackers = [StalenessTracker(shard=str(i)) for i in range(4)]
+        auditor = RouteAuditor(index=sharded, fleet_health=fh, model_name=MODEL)
+        plane = ShardedEventsPool(
+            sharded,
+            ShardedEventsPoolConfig(dispatchers=2),
+            health=fh,
+            staleness=trackers,
+            audit=auditor,
+        )
+        plane.start()
+        pods, links = {}, {}
+        for name in ("pod-a", "pod-b"):
+            links[name] = ChaosLink(plane, name, MODEL)
+            pods[name] = PodServer(
+                _pod_config(name, publish_events=True, obs_audit=True),
+                publisher=links[name],
+            )
+            pods[name].start()
+        router = BlendedRouter(
+            score_fn=lambda toks, names: indexer.score_tokens(toks, MODEL, names),
+            affinity=PrefixAffinityTracker(
+                2, 64,
+                token_processor=ChunkedTokenDatabase(
+                    TokenProcessorConfig(block_size=PS)
+                ),
+            ),
+            loads_fn=lambda names: [pods[n].queue_depth for n in names],
+            auditor=auditor,
+        )
+        names = ["pod-a", "pod-b"]
+        prefix = _prompt(60, 16)
+        try:
+            pods["pod-a"].generate(
+                prefix + _prompt(61, 4), SamplingParams(max_new_tokens=2),
+                timeout=120,
+            )
+            assert plane.drain(10.0)
+            prompt = prefix + _prompt(62, 4)
+            decision = router.route(prompt, names, request_id="shard-acc-1")
+            assert decision.pod == "pod-a"
+            assert decision.index_score == len(prefix) // PS
+            seq = pods["pod-a"].submit(
+                prompt, SamplingParams(max_new_tokens=2),
+                request_id="shard-acc-1",
+            ).result(timeout=120)
+            assert seq.num_cached_prompt == len(prefix)
+            assert plane.drain(10.0)
+        finally:
+            for p in pods.values():
+                p.shutdown()
+            plane.shutdown()
+            indexer.shutdown()
+        (row,) = auditor.recent(request_id="shard-acc-1")
+        assert row["predicted_blocks"] == len(prefix) // PS
+        assert row["realized_blocks"] == row["predicted_blocks"]
+        assert row["ratio"] == 1.0 and row["cause"] is None
+        # the per-shard staleness lanes saw the fleet's event traffic
+        assert MergedStaleness(trackers).snapshot()["events_observed"] > 0
+        assert plane.misroute_snapshot()["total"] == 0
