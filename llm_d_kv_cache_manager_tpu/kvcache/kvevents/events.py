@@ -45,6 +45,19 @@ Disaggregated serving extensions (ISSUE 9; on the wire only when
   event lets the fleet (and the bench/chaos harnesses) observe handoff
   supply without polling pods, and proves liveness like any message.
 
+Remote-tier extension (ISSUE 13; on the wire only when a pod sets
+``REMOTE_TIER``, so default traffic stays bit-identical):
+
+- ``Heartbeat`` grows a trailing ``headroom`` field — how many more
+  demoted pages the pod's remote store will accept. The role position
+  before it is filled with the explicit ``"mixed"`` sentinel when the pod
+  has no role (decodes back to None); pods may also advertise the new
+  ``kvstore`` role, a dedicated holder the scorer excludes from every
+  serving placement. ``BlockStored``/``BlockRemoved`` reuse their
+  existing ``medium`` field with ``"remote"`` — published by the HOLDER
+  pod, so index eviction on pod death drops exactly the entries whose
+  bytes actually died.
+
 Routing-quality observability extension (ISSUE 10; on the wire only when
 a pod sets ``OBS_AUDIT``, so default traffic stays bit-identical):
 
@@ -77,8 +90,11 @@ POD_DRAINED_TAG = "PodDrained"
 PREFILL_COMPLETE_TAG = "PrefillComplete"
 REQUEST_AUDIT_TAG = "RequestAudit"
 
-#: roles a pod may advertise (anything else decodes to None = mixed)
-POD_ROLES = ("prefill", "decode", "mixed")
+#: roles a pod may advertise (anything else decodes to None = mixed).
+#: ``kvstore`` (remote tier, ISSUE 13) marks a dedicated KV-store pod:
+#: it holds demoted blocks and serves transfer pulls but never serves
+#: requests — the scorer keeps it out of EVERY placement.
+POD_ROLES = ("prefill", "decode", "mixed", "kvstore")
 
 
 @dataclass
@@ -124,19 +140,32 @@ class Heartbeat:
     #: pod is mid-drain: stop routing to it (encoded only when true so a
     #: non-draining heartbeat's wire bytes are identical to previous rounds)
     draining: bool = False
-    #: advertised serving role ("prefill"/"decode"; None = mixed, the
-    #: default, never encoded). Drives the scorer's placement filter and
-    #: the two-hop planner's tier split. Trailing-append: the draining
-    #: position before it is filled only when a role follows, so role-less
-    #: heartbeat bytes stay bit-identical legacy.
+    #: advertised serving role ("prefill"/"decode"/"kvstore"; None =
+    #: mixed, the default, never encoded). Drives the scorer's placement
+    #: filter and the two-hop planner's tier split. Trailing-append: the
+    #: draining position before it is filled only when a role follows, so
+    #: role-less heartbeat bytes stay bit-identical legacy.
     role: Optional[str] = None
+    #: remote-tier headroom advertisement (ISSUE 13): how many more
+    #: demoted pages this pod's remote store will accept. None (the
+    #: default, ``REMOTE_TIER`` off) is never encoded — headroom-less
+    #: heartbeat bytes stay bit-identical legacy. Trailing-append: when
+    #: present, the draining/role positions before it are filled (role
+    #: with the explicit "mixed" sentinel, which decodes back to None).
+    headroom: Optional[int] = None
 
     def to_tagged_union(self) -> list[Any]:
         arr: list[Any] = [HEARTBEAT_TAG, self.dropped_batches]
-        if self.draining or self.role is not None:
+        if self.draining or self.role is not None or self.headroom is not None:
             arr.append(bool(self.draining))
         if self.role is not None:
             arr.append(self.role)
+        elif self.headroom is not None:
+            # Positional filler so headroom lands in its own slot; "mixed"
+            # is the explicit spelling of role-None and decodes back to it.
+            arr.append("mixed")
+        if self.headroom is not None:
+            arr.append(int(self.headroom))
         return arr
 
 
@@ -277,7 +306,19 @@ def _decode_event(raw) -> Optional[Event]:
             role = role.decode("utf-8", "replace")
         if role not in POD_ROLES:
             role = None  # tolerant: an unknown role never breaks liveness
-        return Heartbeat(dropped_batches=dropped, draining=draining, role=role)
+        if role == "mixed":
+            # The explicit filler a headroom-carrying mixed pod encodes;
+            # no legacy encoder ever emits it (role-None is simply absent).
+            role = None
+        headroom = _get(fields, 3)
+        if not isinstance(headroom, int) or isinstance(headroom, bool):
+            headroom = None  # tolerant: bad headroom never breaks liveness
+        return Heartbeat(
+            dropped_batches=dropped,
+            draining=draining,
+            role=role,
+            headroom=headroom,
+        )
     if tag == INDEX_SNAPSHOT_TAG:
         raw_digest = _get(fields, 0)
         if not isinstance(raw_digest, dict):

@@ -71,10 +71,15 @@ class _PodState:
     #: pod published its PodDrained goodbye; treated as expired immediately.
     #: Clears on any new message (the pod restarted under the same identity).
     drained: bool = False
-    #: serving role advertised via heartbeat ("prefill"/"decode"); None =
-    #: mixed/unknown — eligible for every placement (observation-only
-    #: default). Set AND cleared by heartbeats, the authoritative carrier.
+    #: serving role advertised via heartbeat ("prefill"/"decode"/
+    #: "kvstore"); None = mixed/unknown — eligible for every placement
+    #: (observation-only default). Set AND cleared by heartbeats, the
+    #: authoritative carrier. ``kvstore`` pods are excluded from EVERY
+    #: serving placement: they hold demoted blocks, they never serve.
     role: Optional[str] = None
+    #: remote-tier headroom the pod last advertised (pages its remote
+    #: store will still accept); None = never advertised (REMOTE_TIER off)
+    headroom: Optional[int] = None
 
 
 class FleetHealth:
@@ -99,6 +104,11 @@ class FleetHealth:
         self.publisher_drops_reported = 0  # guarded_by: _mu
         self.pods_drained = 0  # guarded_by: _mu
         self.prefills_completed = 0  # guarded_by: _mu
+        #: sticky "a kvstore role has ever been advertised" latch: lets the
+        #: role-blind (placement=None) filter keep its zero-lookup fast
+        #: path on fleets with no remote tier — the overwhelmingly common
+        #: case — while kvstore fleets pay the role cut they need.
+        self._any_kvstore = False  # guarded_by: _mu
         self._sweep_thread: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
 
@@ -155,6 +165,7 @@ class FleetHealth:
         dropped_batches: int,
         draining: bool = False,
         role: Optional[str] = None,
+        headroom: Optional[int] = None,
     ) -> None:
         """A heartbeat proves liveness and reports the publisher's drop
         count; an increase means batches were lost even if no later seq
@@ -162,13 +173,22 @@ class FleetHealth:
         the scorer stops returning it immediately (set AND cleared here:
         heartbeats are the authoritative carrier of drain intent).
         ``role`` advertises the pod's serving tier for the placement
-        filter; None (mixed/legacy heartbeats) clears it."""
+        filter; None (mixed/legacy heartbeats) clears it. ``headroom``
+        advertises remote-store acceptance capacity (demotion-target
+        selection + observability); None leaves the last value — a legacy
+        heartbeat from a pod that flipped the knob off mid-run is
+        indistinguishable from one that predates it, and zeroing on
+        absence would erase real advertisements under mixed fleets."""
         with self._mu:
             st = self._pods.setdefault(pod, _PodState())
             st.last_seen = self._clock()
             st.swept = False
             st.draining = draining
-            st.role = role if role in ("prefill", "decode") else None
+            st.role = role if role in ("prefill", "decode", "kvstore") else None
+            if st.role == "kvstore":
+                self._any_kvstore = True
+            if headroom is not None:
+                st.headroom = max(int(headroom), 0)
             self.heartbeats_seen += 1
             if dropped_batches < st.reported_drops:
                 # Publisher restart: its drop counter restarted too. Rebase
@@ -274,11 +294,38 @@ class FleetHealth:
             return (self._clock() - st.last_seen) <= ttl
 
     def role_of(self, pod: str) -> Optional[str]:
-        """The pod's heartbeat-advertised role ("prefill"/"decode"), or
-        None for mixed/unknown pods."""
+        """The pod's heartbeat-advertised role ("prefill"/"decode"/
+        "kvstore"), or None for mixed/unknown pods."""
         with self._mu:
             st = self._pods.get(pod)
             return st.role if st is not None else None
+
+    def headroom_of(self, pod: str) -> Optional[int]:
+        """Remote-store headroom the pod last advertised (pages), or None
+        when it never has (REMOTE_TIER off / pre-knob fleet)."""
+        with self._mu:
+            st = self._pods.get(pod)
+            return st.headroom if st is not None else None
+
+    def remote_targets(self) -> dict[str, int]:
+        """Demotion-target view: every routable-alive pod that has
+        advertised remote headroom, with the last advertised value —
+        kvstore pods first-class, but serving peers with headroom count
+        too. Like ``pod_views``, this is the HTTP-deployment hook (a
+        control plane assembling ``REMOTE_PEERS`` for the fleet from
+        heartbeat state); the in-process pusher ranks its static peer
+        list by push-ack headroom instead. One locked cut
+        (scrape/selection cadence, not per event)."""
+        ttl = self.config.pod_ttl_s
+        now = self._clock()
+        with self._mu:
+            return {
+                pod: st.headroom
+                for pod, st in self._pods.items()
+                if st.headroom is not None
+                and not (st.swept or st.drained or st.draining)
+                and not (ttl > 0 and (now - st.last_seen) > ttl)
+            }
 
     def filter_scores(
         self, scores: dict[str, int], placement: Optional[str] = None
@@ -288,25 +335,48 @@ class FleetHealth:
         sweeper lands) nor one that advertised a drain in progress.
         ``placement`` ("prefill"/"decode"; None = legacy, role-blind)
         additionally excludes pods whose advertised role cannot serve that
-        tier — a prefill-only pod must never win decode placement."""
+        tier — a prefill-only pod must never win decode placement. A
+        ``kvstore`` pod (remote-tier holder) serves NOTHING and is
+        excluded from every SERVING placement, including the role-blind
+        legacy one — its warmth is reachable only as a pull source.
+        ``placement="pull_source"`` is that read path: no role exclusion
+        at all (any pod may export its chains over the transfer fabric),
+        only the liveness gate — a remote-arm query for the holders'
+        warmth must not be blanked by the very filter that keeps them out
+        of serving."""
         if not scores:
             return scores
-        if placement is None:
+        if placement == "pull_source":
+            wrong: set = set()
             roles: dict[str, Optional[str]] = {}
+        elif placement is None:
+            # One locked cut for the latch AND (when needed) the roles —
+            # this runs per scoring request, and a second acquisition
+            # would double the lock churn is_routable already pays.
+            with self._mu:
+                roles = (
+                    {
+                        p: (st.role if (st := self._pods.get(p)) else None)
+                        for p in scores
+                    }
+                    if self._any_kvstore
+                    else {}
+                )
+            wrong = {"kvstore"}
         else:
-            # One locked cut for every candidate's role (this runs per
-            # scoring request; a per-pod role_of() would double the lock
-            # churn is_routable already pays).
             with self._mu:
                 roles = {
                     p: (st.role if (st := self._pods.get(p)) else None)
                     for p in scores
                 }
-        wrong_tier = "prefill" if placement == "decode" else "decode"
+            wrong = {
+                "kvstore",
+                "prefill" if placement == "decode" else "decode",
+            }
         out = {
             p: s
             for p, s in scores.items()
-            if roles.get(p) != wrong_tier and self.is_routable(p)
+            if roles.get(p) not in wrong and self.is_routable(p)
         }
         return out if len(out) != len(scores) else scores
 
@@ -343,9 +413,15 @@ class FleetHealth:
                     "draining": st.draining,
                     "drained": st.drained,
                     "age_s": round(self._clock() - st.last_seen, 3),
-                    # Role key only for role-advertising pods: a role-less
-                    # fleet's snapshot payload stays bit-identical legacy.
+                    # Role/headroom keys only for advertising pods: a
+                    # knob-less fleet's snapshot payload stays bit-identical
+                    # legacy.
                     **({"role": st.role} if st.role is not None else {}),
+                    **(
+                        {"headroom": st.headroom}
+                        if st.headroom is not None
+                        else {}
+                    ),
                 }
                 for pod, st in self._pods.items()
             }

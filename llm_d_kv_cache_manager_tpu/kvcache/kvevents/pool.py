@@ -253,6 +253,7 @@ class KVEventsPool:
                         ev.dropped_batches,
                         ev.draining,
                         role=ev.role,
+                        headroom=ev.headroom,
                     )
             elif isinstance(ev, PrefillComplete):
                 # Observation-only: the chain's BlockStored events already

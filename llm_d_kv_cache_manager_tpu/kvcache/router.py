@@ -132,17 +132,35 @@ class BlendedRouter:
         loads_fn: Callable[[Sequence[str]], Sequence[float]],
         cost_model=None,
         auditor=None,
+        remote_score_fn: Optional[Callable] = None,
+        remote_endpoint_of: Optional[Callable[[str], Optional[str]]] = None,
     ):
         """``auditor`` (optional, an ``obs.RouteAuditor``): records each
         decision's predicted matched-block count + scoreboard keyed by
         request id, so the pod's realized prefix-cache hits can be joined
         back into the predicted-vs-realized / regret / miss-attribution
-        metrics. None (default) records nothing — legacy behavior."""
+        metrics. None (default) records nothing — legacy behavior.
+
+        ``remote_score_fn(tokens) -> {holder: blocks}`` (optional, the
+        ``REMOTE_TIER`` read path): warmth held by NON-serving remote
+        holders — kvstore pods and peers' remote stores, scored through
+        the same index on their ``medium="remote"`` entries. With it (and
+        a ``cost_model``) the router gains the demoted-warmth arm: when a
+        holder has strictly more of the prefix than the warmest serving
+        pod and the measured cost model says moving it beats recomputing,
+        the decision becomes a pull from the holder onto the best serving
+        target — a remote hit beats recompute but loses to a warm local
+        hit. ``remote_endpoint_of(holder) -> transfer endpoint`` maps the
+        holder's pod identity to its export endpoint (None keeps the pod
+        name, which in-process fleets use directly). Both None (default)
+        = bit-identical legacy routing."""
         self.score_fn = score_fn
         self.affinity = affinity
         self.loads_fn = loads_fn
         self.cost_model = cost_model
         self.auditor = auditor
+        self.remote_score_fn = remote_score_fn
+        self.remote_endpoint_of = remote_endpoint_of
 
     def route(
         self,
@@ -178,6 +196,42 @@ class BlendedRouter:
                     pull_source, pull_blocks = pods[best], warm_blocks
                 elif verdict == "cold":
                     target, action = coldest, "cold"
+        if (
+            self.remote_score_fn is not None
+            and self.cost_model is not None
+            and action != "pull"
+        ):
+            remote = self.remote_score_fn(tokens)
+            if remote:
+                # Deterministic best holder: most blocks, name tiebreak.
+                holder, rblocks = max(
+                    remote.items(), key=lambda kv: (kv[1], kv[0])
+                )
+                if rblocks > warm_blocks:
+                    # The demoted copy holds strictly more of the prefix
+                    # than any serving pod. Land on the stickiest/least
+                    # loaded target and pull — if the measured cost model
+                    # says the move beats both the warm local option and
+                    # recompute (remote beats recompute, loses to warm).
+                    tgt = max(
+                        range(len(pods)),
+                        key=lambda i: (aff_scores[i], -loads[i], -i),
+                    )
+                    verdict = self.cost_model.decide_remote(
+                        prompt_len=len(tokens),
+                        remote_blocks=rblocks,
+                        target_load=loads[tgt],
+                        warm_blocks=warm_blocks,
+                        warm_load=loads[best],
+                    )
+                    if verdict == "pull":
+                        target, action = tgt, "pull"
+                        pull_blocks = rblocks
+                        pull_source = (
+                            self.remote_endpoint_of(holder)
+                            if self.remote_endpoint_of is not None
+                            else holder
+                        ) or holder
         self.affinity.record(keys, target, now)
         # Routing-quality observability: verdict counts let dashboards see
         # the warm/pull/cold mix shift as the fleet warms or thrashes

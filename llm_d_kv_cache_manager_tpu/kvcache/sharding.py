@@ -700,6 +700,7 @@ class ShardedEventsPool:
                         ev.dropped_batches,
                         ev.draining,
                         role=ev.role,
+                        headroom=ev.headroom,
                     )
             elif isinstance(ev, PrefillComplete):
                 if self.health is not None:

@@ -10,7 +10,10 @@ KV). Three pieces:
 - ``service`` / ``client``: ZMQ ROUTER/DEALER request channel — each pod
   binds an export service; peers fetch prefix chains by block hash;
 - ``cost_model``: measured bytes/s-vs-tokens/s accounting behind the
-  router's route-to-warm / pull-then-compute / cold-recompute decision.
+  router's route-to-warm / pull-then-compute / cold-recompute decision;
+- ``remote_store``: the ``remote`` tier's holder side (``REMOTE_TIER``) —
+  wire-ready demoted blocks, LRU-bounded, published to the index under
+  the HOLDER's identity with ``medium="remote"``.
 
 The engine-side export/import endpoints live in ``server/engine.py`` and
 ``server/block_manager.py``; ``server/serve.py`` wires the service into a
@@ -21,16 +24,22 @@ from .client import (
     CircuitBreaker,
     KVTransferClient,
     TransferClientConfig,
+    TransferClientPool,
     TransferError,
 )
 from .cost_model import TransferCostModel, TransferCostModelConfig
 from .protocol import (
     BlockPayload,
+    decode_push,
+    decode_push_ack,
     decode_request,
     decode_response,
+    encode_push,
+    encode_push_ack,
     encode_request,
     encode_response,
 )
+from .remote_store import RemoteBlockStore, RemoteStoreConfig
 from .service import KVTransferService, TransferServiceConfig
 
 __all__ = [
@@ -38,13 +47,20 @@ __all__ = [
     "CircuitBreaker",
     "KVTransferClient",
     "KVTransferService",
+    "RemoteBlockStore",
+    "RemoteStoreConfig",
     "TransferClientConfig",
+    "TransferClientPool",
     "TransferCostModel",
     "TransferCostModelConfig",
     "TransferError",
     "TransferServiceConfig",
+    "decode_push",
+    "decode_push_ack",
     "decode_request",
     "decode_response",
+    "encode_push",
+    "encode_push_ack",
     "encode_request",
     "encode_response",
 ]

@@ -34,7 +34,13 @@ from typing import Callable, Optional, Sequence
 
 from ...utils import get_logger
 from ..metrics import collector
-from .protocol import BlockPayload, decode_response, encode_request
+from .protocol import (
+    BlockPayload,
+    decode_push_ack,
+    decode_response,
+    encode_push,
+    encode_request,
+)
 
 log = get_logger("kvcache.transfer.client")
 
@@ -183,6 +189,13 @@ class KVTransferClient:
                 config.breaker_backoff_max_s,
             )
         self.breaker_skips = 0  # fetches rejected instantly by an open breaker
+        #: connection-reuse accounting: dials = sockets created (first use
+        #: + post-timeout rebuilds), reuses = requests served on an
+        #: already-connected DEALER. The saved dial time shows up directly
+        #: in the ``kvcache_transfer_pull_seconds`` histogram — a reused
+        #: socket's sample carries no connect/handshake share.
+        self.dials = 0  # guarded_by: _mu
+        self.reuses = 0  # guarded_by: _mu
         self._mu = threading.Lock()
         self._sock = None  # guarded_by: _mu
         self._closed = False  # guarded_by: _mu
@@ -196,6 +209,9 @@ class KVTransferClient:
             # zmq connect is asynchronous (registers the endpoint with the
             # io thread; no handshake wait), so it cannot convoy the lock.
             self._sock.connect(self.config.endpoint)  # kvlint: disable=lock-discipline
+            self.dials += 1
+        else:
+            self.reuses += 1
         return self._sock
 
     def _reset_socket(self) -> None:  # kvlint: holds=_mu
@@ -242,14 +258,65 @@ class KVTransferClient:
             self.breaker.record_success()
         return blocks, complete
 
-    def _fetch_once(
+    def push_blocks(
         self,
         model_name: str,
-        block_hashes: Sequence[int],
-        max_blocks: Optional[int],
+        source_pod: str,
+        blocks: Sequence[BlockPayload],
         timeout_s: Optional[float] = None,
-        traceparent: Optional[str] = None,
-    ) -> tuple[list[BlockPayload], bool]:
+    ) -> tuple[int, int]:
+        """Demotion push: ship ``blocks`` to the peer's remote store.
+        Returns ``(accepted, headroom)`` from the ack; raises
+        ``TransferError`` on timeout/refusal (the caller's fallback is
+        plain eviction — the pages are simply gone, exactly the legacy
+        outcome). Shares the fetch path's socket, lock, breaker, and
+        teardown discipline, so a dead demotion target costs one timeout
+        (then breaker-fast failures), never a wedged engine."""
+        if not blocks:
+            return 0, 0
+        if self.breaker is not None and not self.breaker.allow():
+            self.breaker_skips += 1
+            raise TransferError(
+                f"circuit open for {self.config.endpoint} "
+                f"(skipping push; plain eviction)"
+            )
+        try:
+            reply, dt = self._request_reply(
+                encode_push(model_name, source_pod, list(blocks)),
+                timeout_s,
+                kind="push",
+            )
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        decoded = decode_push_ack(reply)
+        if decoded is None:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise TransferError("undecodable push ack")
+        accepted, headroom, error = decoded
+        if error is not None:
+            # A refusal (legacy peer, store off, model mismatch) is a
+            # protocol-level answer from a LIVE peer: settle the breaker
+            # closed — fast-failing future pulls over a healthy link
+            # because the peer declines pushes would be self-harm.
+            if self.breaker is not None:
+                self.breaker.record_success()
+            raise TransferError(f"peer refused push: {error}")
+        if self.breaker is not None:
+            self.breaker.record_success()
+        if self.on_sample is not None and accepted:
+            self.on_sample(
+                sum(b.wire_bytes for b in blocks[:accepted]), dt
+            )
+        return accepted, headroom
+
+    def _request_reply(
+        self, payload: bytes, timeout_s: Optional[float], kind: str
+    ) -> tuple[bytes, float]:
+        """One send→recv cycle on the pooled DEALER with the hard-deadline
+        poll and the teardown-on-timeout rule; returns (reply, seconds)."""
         import zmq
 
         deadline_s = self.config.timeout_s if timeout_s is None else timeout_s
@@ -259,15 +326,11 @@ class KVTransferClient:
             sock = self._socket()
             t0 = time.perf_counter()
             try:
-                sock.send(
-                    encode_request(
-                        model_name, block_hashes, max_blocks, traceparent
-                    )
-                )
+                sock.send(payload)
                 if not sock.poll(int(deadline_s * 1000), zmq.POLLIN):
                     self._reset_socket()  # a late reply must not leak forward
                     raise TransferError(
-                        f"fetch timed out after {deadline_s}s "
+                        f"{kind} timed out after {deadline_s}s "
                         f"({self.config.endpoint})"
                     )
                 # Recv under _mu on purpose: ZMQ sockets are not thread-safe
@@ -278,9 +341,24 @@ class KVTransferClient:
                 frames = sock.recv_multipart()  # kvlint: disable=lock-discipline
             except zmq.ZMQError as e:
                 self._reset_socket()
-                raise TransferError(f"fetch failed: {e}") from e
+                raise TransferError(f"{kind} failed: {e}") from e
             dt = time.perf_counter() - t0
-        decoded = decode_response(frames[-1])
+        return frames[-1], dt
+
+    def _fetch_once(
+        self,
+        model_name: str,
+        block_hashes: Sequence[int],
+        max_blocks: Optional[int],
+        timeout_s: Optional[float] = None,
+        traceparent: Optional[str] = None,
+    ) -> tuple[list[BlockPayload], bool]:
+        reply, dt = self._request_reply(
+            encode_request(model_name, block_hashes, max_blocks, traceparent),
+            timeout_s,
+            kind="fetch",
+        )
+        decoded = decode_response(reply)
         if decoded is None:
             raise TransferError("undecodable transfer response")
         blocks, complete, error = decoded
@@ -296,3 +374,74 @@ class KVTransferClient:
                 return
             self._closed = True
             self._reset_socket()
+
+    @property
+    def closed(self) -> bool:
+        with self._mu:
+            return self._closed
+
+
+class TransferClientPool:
+    """Per-endpoint ``KVTransferClient`` pool: one long-lived DEALER per
+    peer, shared by every caller that talks to that peer (``pull_prefix``,
+    async-pull workers, demotion pushes), so repeat traffic to the same
+    endpoint reuses the connected socket instead of re-dialing — the
+    saved dial shows up directly in ``kvcache_transfer_pull_seconds``,
+    and the per-client ``dials``/``reuses`` counters quantify it.
+
+    Invalidation is breaker-aware: an OPEN breaker does NOT discard the
+    client (the breaker state is precisely the knowledge worth keeping —
+    a fresh client would pay a full timeout the breaker exists to skip);
+    only a client someone ``close()``d is replaced on the next ``get``.
+
+    ``config_factory(endpoint) -> TransferClientConfig`` supplies the
+    per-peer config (timeouts, breaker thresholds); ``on_sample`` is the
+    shared measured-link feed for the routing cost model.
+    """
+
+    def __init__(self, config_factory, on_sample=None):
+        self._config_factory = config_factory
+        self._on_sample = on_sample
+        self._mu = threading.Lock()
+        self._clients: dict[str, KVTransferClient] = {}  # guarded_by: _mu
+        self._closed = False  # guarded_by: _mu
+
+    def get(self, endpoint: str) -> Optional[KVTransferClient]:
+        """The pooled client for ``endpoint`` (created on first use).
+        None once the pool is closed — a client created after the
+        shutdown sweep would leak its socket."""
+        with self._mu:
+            if self._closed:
+                return None
+            client = self._clients.get(endpoint)
+            if client is None or client.closed:
+                client = KVTransferClient(
+                    self._config_factory(endpoint), on_sample=self._on_sample
+                )
+                self._clients[endpoint] = client
+            return client
+
+    def snapshot(self) -> dict:
+        """Per-endpoint reuse/breaker accounting for ``/stats``."""
+        with self._mu:
+            clients = dict(self._clients)
+        out = {}
+        for ep, c in clients.items():
+            with c._mu:
+                dials, reuses = c.dials, c.reuses
+            entry = {"dials": dials, "reuses": reuses}
+            if c.breaker is not None:
+                entry["breaker"] = c.breaker.snapshot()
+            out[ep] = entry
+        return out
+
+    def clients(self) -> dict[str, KVTransferClient]:
+        with self._mu:
+            return dict(self._clients)
+
+    def close_all(self) -> None:
+        with self._mu:
+            self._closed = True
+            clients, self._clients = list(self._clients.values()), {}
+        for client in clients:
+            client.close()

@@ -146,3 +146,46 @@ class TransferCostModel:
         if t_cold < best:
             action = COLD
         return action
+
+    def decide_remote(
+        self,
+        prompt_len: int,
+        remote_blocks: int,
+        target_load: float,
+        warm_blocks: int = 0,
+        warm_load: float = 0.0,
+    ) -> str:
+        """Remote-tier verdict: should the router pull ``remote_blocks``
+        of this prompt's prefix from a remote holder (kvstore pod / peer
+        remote store) onto the least-loaded serving pod?
+
+        A remote hit must beat RECOMPUTE but lose to a warm LOCAL hit:
+        ``pull`` is returned only when the modeled pull time undercuts
+        BOTH serving at the warmest local pod (``warm_blocks`` there)
+        and plain cold recompute on the target. The holder is storage,
+        not compute, so "queue behind the warmth" is not an arm here.
+        Abstains (``route_warm`` = let the legacy ranking stand) until
+        both rates are measured, mirroring ``decide``'s bootstrap rule."""
+        cfg = self.config
+        with self._mu:
+            tr, pr = self._transfer_rate, self._prefill_rate
+        if tr is None or pr is None or remote_blocks < cfg.min_pull_blocks:
+            return ROUTE_WARM
+        pull_blocks = remote_blocks
+        if cfg.max_pull_blocks is not None:
+            pull_blocks = min(pull_blocks, cfg.max_pull_blocks)
+        pull_tokens = min(pull_blocks * cfg.block_size, max(prompt_len - 1, 0))
+        warm_tokens = min(warm_blocks * cfg.block_size, max(prompt_len - 1, 0))
+        q = cfg.est_service_s
+        t_pull = (
+            target_load * q
+            + pull_blocks * cfg.block_bytes / tr
+            + max(prompt_len - pull_tokens, 1) / pr
+        )
+        t_cold = target_load * q + prompt_len / pr
+        t_local = (
+            warm_load * q + max(prompt_len - warm_tokens, 1) / pr
+            if warm_blocks > 0
+            else t_cold
+        )
+        return PULL if t_pull < min(t_local, t_cold) else ROUTE_WARM

@@ -18,6 +18,20 @@ raising — a poison request must never kill the export service).
   importers, positional and tolerant, simply ignore it)
 - error: ``["TransferError", message]``
 
+Remote-tier demotion extension (``REMOTE_TIER``; never on the wire unless
+a pod enables the knob, so default traffic is bit-identical and old
+services answer an unknown tag with a tolerant ``TransferError`` the
+pusher treats as "fall back to plain eviction"):
+
+- push: ``["PushBlocks", model_name, source_pod, [block, ...]]`` — a pod
+  about to destroy the last local copy of a chain ships the pages to a
+  peer with headroom instead; block rows reuse the ``Blocks`` response
+  encoding (including the optional trailing int8 quant triple, which
+  halves demotion bytes exactly as it halves pull bytes).
+- ack: ``["PushAck", accepted, headroom]`` — how many blocks the peer
+  committed to its remote store, and how many more pages it will take
+  (the pusher's per-peer headroom feed between heartbeats).
+
 Hashes are uint64 (the sha256-CBOR chain the whole system keys on); page
 payloads ride as raw bytes of the engine's ``[n_layers, page_size,
 n_kv_heads, head_dim]`` page slice, dtype/shape-tagged so the importer can
@@ -34,6 +48,8 @@ import msgpack
 FETCH_BLOCKS_TAG = "FetchBlocks"
 BLOCKS_TAG = "Blocks"
 ERROR_TAG = "TransferError"
+PUSH_BLOCKS_TAG = "PushBlocks"
+PUSH_ACK_TAG = "PushAck"
 
 
 @dataclass
@@ -120,24 +136,29 @@ def decode_request(
     return model, hashes, max_blocks, traceparent
 
 
+def encode_block_row(b: BlockPayload) -> list:
+    """One block's wire row — shared by the ``Blocks`` response and the
+    ``PushBlocks`` demotion request so both sides of the fabric speak one
+    block encoding (and the kvlint wire manifest pins it once)."""
+    raw: list = [
+        b.block_hash,
+        b.parent_block_hash,
+        list(b.token_ids),
+        b.block_size,
+        b.dtype,
+        list(b.shape),
+        b.k_data,
+        b.v_data,
+    ]
+    if b.quant is not None:
+        # Trailing optional triple: only on the wire for quantized
+        # blocks, so unquantized response bytes stay bit-identical.
+        raw.extend([b.quant, b.k_scale, b.v_scale])
+    return raw
+
+
 def encode_response(blocks: Sequence[BlockPayload], complete: bool) -> bytes:
-    encoded = []
-    for b in blocks:
-        raw: list = [
-            b.block_hash,
-            b.parent_block_hash,
-            list(b.token_ids),
-            b.block_size,
-            b.dtype,
-            list(b.shape),
-            b.k_data,
-            b.v_data,
-        ]
-        if b.quant is not None:
-            # Trailing optional triple: only on the wire for quantized
-            # blocks, so unquantized response bytes stay bit-identical.
-            raw.extend([b.quant, b.k_scale, b.v_scale])
-        encoded.append(raw)
+    encoded = [encode_block_row(b) for b in blocks]
     return msgpack.packb(
         [BLOCKS_TAG, bool(complete), encoded], use_bin_type=True
     )
@@ -201,6 +222,74 @@ def _decode_block(raw: Any) -> Optional[BlockPayload]:
             k_scale=bytes(k_scale),
             v_scale=bytes(v_scale),
         )
+    except (TypeError, ValueError):
+        return None
+
+
+def encode_push(
+    model_name: str, source_pod: str, blocks: Sequence[BlockPayload]
+) -> bytes:
+    """Demotion push request: ship ``blocks`` to a peer's remote store."""
+    return msgpack.packb(
+        [
+            PUSH_BLOCKS_TAG,
+            model_name,
+            source_pod,
+            [encode_block_row(b) for b in blocks],
+        ],
+        use_bin_type=True,
+    )
+
+
+def decode_push(
+    payload: bytes,
+) -> Optional[tuple[str, str, list[BlockPayload]]]:
+    """``(model_name, source_pod, blocks)`` or None for non-push/garbage
+    frames (the service tries ``decode_request`` first; a frame neither
+    decoder accepts answers with a tolerant error, never a crash)."""
+    arr = _unpack(payload)
+    if (
+        not isinstance(arr, (list, tuple))
+        or len(arr) < 4
+        or _text(arr[0]) != PUSH_BLOCKS_TAG
+        or not isinstance(arr[3], (list, tuple))
+    ):
+        return None
+    model = _text(arr[1])
+    source = _text(arr[2])
+    if not isinstance(model, str) or not model or not isinstance(source, str):
+        return None
+    blocks: list[BlockPayload] = []
+    for raw in arr[3]:
+        blk = _decode_block(raw)
+        if blk is None:
+            return None  # a half-garbled block corrupts the chain: reject all
+        blocks.append(blk)
+    return model, source, blocks
+
+
+def encode_push_ack(accepted: int, headroom: int) -> bytes:
+    return msgpack.packb(
+        [PUSH_ACK_TAG, int(accepted), int(headroom)], use_bin_type=True
+    )
+
+
+def decode_push_ack(
+    payload: bytes,
+) -> Optional[tuple[int, int, Optional[str]]]:
+    """``(accepted, headroom, error)``; ``error`` set for service-side
+    refusals (including legacy services that do not speak the push op),
+    None return for undecodable payloads."""
+    arr = _unpack(payload)
+    if not isinstance(arr, (list, tuple)) or not arr:
+        return None
+    tag = _text(arr[0])
+    if tag == ERROR_TAG:
+        return 0, 0, _text(arr[1]) if len(arr) > 1 else "unknown error"
+    if tag != PUSH_ACK_TAG or len(arr) < 3:
+        return None
+    try:
+        return int(arr[1]), int(arr[2]), None
     except (TypeError, ValueError):
         return None
 

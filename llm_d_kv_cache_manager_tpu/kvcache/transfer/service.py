@@ -22,7 +22,14 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 from ...utils import get_logger
-from .protocol import BlockPayload, decode_request, encode_error, encode_response
+from .protocol import (
+    BlockPayload,
+    decode_push,
+    decode_request,
+    encode_error,
+    encode_push_ack,
+    encode_response,
+)
 
 log = get_logger("kvcache.transfer.service")
 
@@ -48,19 +55,30 @@ class KVTransferService:
         config: TransferServiceConfig,
         handler: Callable[[list[int], int], Sequence[BlockPayload]],
         tracer=None,
+        push_handler: Optional[
+            Callable[[str, list[BlockPayload]], tuple[int, int]]
+        ] = None,
     ):
         """``tracer`` (an ``obs.Tracer``, optional): when tracing is on,
         each served fetch records a ``transfer.export`` span, parented on
         the ``traceparent`` the puller carried in the request envelope —
-        the exporting peer's time joins the pulling request's trace."""
+        the exporting peer's time joins the pulling request's trace.
+        ``push_handler`` (``(source_pod, blocks) -> (accepted, headroom)``,
+        optional): accepts remote-tier demotion pushes into this pod's
+        remote store. None (default, ``REMOTE_TIER`` off) answers pushes
+        with a tolerant error the pusher treats as "fall back to plain
+        eviction" — exactly what a legacy service does."""
         self.config = config
         self.handler = handler
         self.tracer = tracer
+        self.push_handler = push_handler
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: observability, read by /stats
         self.requests_served = 0
         self.blocks_served = 0
+        self.pushes_served = 0
+        self.blocks_pushed = 0
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -120,6 +138,9 @@ class KVTransferService:
     def _handle(self, payload: bytes) -> bytes:
         req = decode_request(payload)
         if req is None:
+            push = decode_push(payload)
+            if push is not None:
+                return self._handle_push(*push)
             return encode_error("malformed request")
         model, hashes, max_blocks, traceparent = req
         span = None
@@ -158,6 +179,30 @@ class KVTransferService:
         finally:
             if span is not None:
                 span.end()
+
+    def _handle_push(
+        self, model: str, source_pod: str, blocks: list[BlockPayload]
+    ) -> bytes:
+        """Remote-tier demotion push: commit the blocks via the pod's
+        ``push_handler`` and ack (accepted, headroom). Refusals are plain
+        protocol errors — the pusher's fallback is the eviction it was
+        about to do anyway, so nothing here may raise."""
+        if self.push_handler is None:
+            return encode_error("push unsupported (REMOTE_TIER off)")
+        if model != self.config.model_name:
+            return encode_error(
+                f"model mismatch: serving {self.config.model_name!r}"
+            )
+        try:
+            accepted, headroom = self.push_handler(
+                source_pod, blocks[: self.config.max_blocks]
+            )
+        except Exception as e:
+            log.exception("push handler failed")
+            return encode_error(f"push failed: {type(e).__name__}")
+        self.pushes_served += 1
+        self.blocks_pushed += accepted
+        return encode_push_ack(accepted, headroom)
 
     def _cap_bytes(
         self, blocks: list[BlockPayload], n_requested: int

@@ -49,13 +49,19 @@ log = get_logger("server.api")
 
 
 def _parse_placement(body: dict):
-    """The optional disagg ``placement`` field both scoring endpoints
-    accept: returns ``(placement, None)`` when valid ("prefill"/"decode"/
-    absent) or ``(None, 400-response)`` for anything else."""
+    """The optional ``placement`` field both scoring endpoints accept:
+    returns ``(placement, None)`` when valid ("prefill"/"decode" for the
+    disagg tiers, "pull_source" for the remote-tier read path — no role
+    exclusion, liveness gate only, so kvstore holders are scorable as
+    pull sources — or absent) or ``(None, 400-response)`` for anything
+    else."""
     placement = body.get("placement")
-    if placement not in (None, "prefill", "decode"):
+    if placement not in (None, "prefill", "decode", "pull_source"):
         return None, web.json_response(
-            {"error": "placement must be 'prefill' or 'decode' when set"},
+            {
+                "error": "placement must be 'prefill', 'decode' or "
+                "'pull_source' when set"
+            },
             status=400,
         )
     return placement, None

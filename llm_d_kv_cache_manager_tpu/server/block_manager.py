@@ -87,6 +87,14 @@ class BlockManager:
         self._copy_out = None  # (device_page, host_slot) -> None
         self._copy_in = None  # (host_slot, device_page) -> None
         self._restore_policy = None  # (n_pages) -> bool; None = always
+        #: remote-tier demotion hook (REMOTE_TIER): called when an
+        #: eviction is about to destroy the LAST local copy of a block —
+        #: ``(info, tier, idx)`` with tier "tpu_hbm" (idx = device page,
+        #: contents intact until the next dispatch) or "host_dram" (idx =
+        #: host slot, caller must snapshot NOW — the slot is reused
+        #: immediately). None (default) = plain eviction, bit-identical
+        #: legacy behavior.
+        self._demote = None
         self._host_free: list[int] = list(range(config.host_pages - 1, -1, -1))
         self._host_cached: dict[int, int] = {}  # chain_hash -> host slot
         self._host_info: dict[int, _PageInfo] = {}  # host slot -> metadata
@@ -118,6 +126,15 @@ class BlockManager:
         self._copy_in = copy_in
         self._restore_policy = restore_policy
 
+    def attach_demoter(self, demote_fn) -> None:
+        """Install the engine's remote-tier demotion hook (``REMOTE_TIER``
+        knob): ``demote_fn(info, tier, idx)`` fires whenever eviction
+        would destroy the last local copy of a cached block, BEFORE the
+        ``BlockRemoved`` is emitted. The hook only queues (the engine
+        batches payload builds with the page-move flush); it must never
+        block or raise."""
+        self._demote = demote_fn
+
     @property
     def num_host_cached_pages(self) -> int:
         return len(self._host_cached)
@@ -134,6 +151,12 @@ class BlockManager:
         info = self._host_info.pop(slot)
         del self._host_cached[info.chain_hash]
         self.host_stats["host_evicted"] += 1
+        if self._demote is not None:
+            # Host-LRU drop destroys the only copy (tiers are disjoint:
+            # a host-cached block is never simultaneously HBM-cached) —
+            # demote it instead of losing it. The hook snapshots the slot
+            # NOW; the caller reuses it immediately after.
+            self._demote(info, "host_dram", slot)
         self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="host_dram"))
         return slot
 
@@ -208,6 +231,17 @@ class BlockManager:
             assert info.ref_count == 0 and info.chain_hash is not None
             del self._cached[info.chain_hash]
             self._try_offload(page, info)
+            if (
+                self._demote is not None
+                and info.chain_hash not in self._host_cached
+            ):
+                # The host tier didn't keep a copy (absent, full, or the
+                # cost model declined the spill): this recycle destroys
+                # the last local copy — demote over the fabric instead.
+                # The hook queues a snapshot of the page, whose contents
+                # stay intact until the next device dispatch (the same
+                # window the host-tier offload gather relies on).
+                self._demote(info, "tpu_hbm", page)
             self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="tpu_hbm"))
             self._pages[page] = _PageInfo(ref_count=1)
             return page
@@ -352,7 +386,11 @@ class BlockManager:
         return out
 
     def install_imported_block(
-        self, h: int, parent_hash: Optional[int], token_ids: Seq[int]
+        self,
+        h: int,
+        parent_hash: Optional[int],
+        token_ids: Seq[int],
+        allow_evict: bool = False,
     ) -> Optional[int]:
         """Commit a transferred block as a prefix-cache page: allocate a
         page, register it under ``h`` (ref 0, evictable — imports are
@@ -361,16 +399,25 @@ class BlockManager:
         page the caller must write the KV bytes into, or ``None`` when the
         block is already resident in some tier (nothing to do).
 
-        Only genuinely FREE pages are used — an import never evicts
-        locally-warm pages (raises ``AllocationError`` instead): evicting
-        proven-warm state for speculative remote warmth would let a pull
-        storm thrash the very cache the transfer plane exists to protect.
-        """
+        By default only genuinely FREE pages are used — an import never
+        evicts locally-warm pages (raises ``AllocationError`` instead):
+        evicting proven-warm state for speculative remote warmth would let
+        a pull storm thrash the very cache the transfer plane exists to
+        protect. ``allow_evict=True`` (the ``REMOTE_TIER`` import path)
+        relaxes this to the normal eviction ladder: with a demoter
+        attached, the recycled victim spills to host or demotes over the
+        fabric, so making room for routed-for warmth is LOSSLESS — the
+        original rationale no longer applies. Imported pages land at the
+        evictable MRU end, so a multi-block import never recycles its own
+        chain."""
         if self.is_block_resident(h):
             return None
-        if not self._free:
+        if self._free:
+            page = self._free.pop()
+        elif allow_evict:
+            page = self._pop_free_page()  # recycles LRU; victim spills/demotes
+        else:
             raise AllocationError("no free pages for imported KV block")
-        page = self._free.pop()
         info = _PageInfo(
             ref_count=0,
             chain_hash=h,

@@ -181,6 +181,21 @@ class EngineConfig:
     #: the current step) instead of restoring synchronously inside
     #: allocate. Off by default = bit-identical legacy scheduling.
     host_prefetch: bool = False
+    #: remote tier (ISSUE 13, ``REMOTE_TIER``): when local eviction (HBM
+    #: recycle or host-LRU drop) would destroy the LAST local copy of a
+    #: cached block, build a wire-ready demotion payload (int8-quantized
+    #: under ``kv_quant``, halving demotion bytes) and hand it to
+    #: ``on_demotion`` — the serving layer pushes it to a peer with
+    #: headroom / a kvstore pod over the transfer fabric. Also relaxes
+    #: the import path to the normal eviction ladder (victims demote, so
+    #: making room for routed-for warmth is lossless). Off by default =
+    #: bit-identical legacy eviction.
+    remote_tier: bool = False
+    #: remote-store capacity in pages: how many demoted blocks THIS pod
+    #: will hold for peers (0 = accept nothing; a dedicated kvstore pod
+    #: sets this large and serves nothing else). Gated behind
+    #: ``remote_tier``; sizing guidance in docs/operations.md.
+    remote_store_pages: int = 0
     #: weight quantization: None (serve in model dtype) or "int8"
     #: (symmetric per-output-channel weight-only int8 — halves weight HBM
     #: bytes so 8B-class models fit one v5e chip with a KV pool;
@@ -409,6 +424,47 @@ class Engine:
         self._pending_restores: list = []
         self._off_by_slot: dict = {}
         self._restore_by_page: dict = {}
+        # -- remote tier (REMOTE_TIER; off = none of this exists) ----------
+        #: demotion payload sink, set by the serving layer (PodServer's
+        #: background pusher) or the bench arm; None drops demotions on
+        #: the floor = plain eviction.
+        self.on_demotion: Optional[Callable[[list], None]] = None
+        #: queued (info, src) demotions, resolved at the page-move flush
+        self._pending_demotions: list = []
+        self.remote_stats = {
+            "demoted_blocks": 0,
+            "demote_batches": 0,
+            "accepted_blocks": 0,
+        }
+        self.remote_store = None
+        if config.remote_tier and config.remote_store_pages > 0:
+            from ..kvcache.transfer.remote_store import (
+                RemoteBlockStore,
+                RemoteStoreConfig,
+            )
+
+            def _store_events(events):
+                # Late-bound: PodServer may attach the publisher to the
+                # block manager AFTER engine construction (injected
+                # engines); the store must see the same sink it does.
+                sink = self.block_manager.on_events
+                if sink is not None:
+                    sink(events)
+
+            shape = (cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
+            self.remote_store = RemoteBlockStore(
+                RemoteStoreConfig(
+                    capacity_pages=config.remote_store_pages,
+                    page_size=ps,
+                    page_shape=shape,
+                    dtype=str(np.dtype(jnp.dtype(cfg.dtype).name)),
+                    scale_bytes=int(np.prod(quant.kv_scale_shape(shape))) * 4,
+                    init_hash=self.block_manager.token_db.init_hash,
+                ),
+                on_events=_store_events,
+            )
+        if config.remote_tier:
+            self.block_manager.attach_demoter(self._queue_demotion)
         #: host-tier prefetch observability (host_prefetch knob): rounds =
         #: steps where the stage ran and found work, pages = host blocks
         #: brought back ahead of allocate, seqs = waiting sequences whose
@@ -509,6 +565,127 @@ class Engine:
         self._pending_restores.append((page, src))
         self._restore_by_page[page] = src
 
+    # -- remote-tier demotion (REMOTE_TIER) ---------------------------------
+    def _queue_demotion(self, info, tier: str, idx: int) -> None:
+        """Block-manager demotion hook: the last local copy of
+        ``info.chain_hash`` is being destroyed — queue a snapshot so the
+        flush builds a wire-ready payload for the serving layer's pusher.
+        HBM pages defer to the flush gather (contents are intact until
+        the next dispatch, same window the offload path uses); host slots
+        snapshot NOW (the slot is reused immediately). No sink attached =
+        plain eviction, zero work."""
+        if self.on_demotion is None:
+            return
+        if tier == "tpu_hbm":
+            src = self._restore_by_page.get(idx, ("page", idx))
+        else:  # host_dram
+            src = self._off_by_slot.get(idx)
+            if src is None:
+                if self.config.kv_quant == "int8":
+                    # Ship the stored int8 codes + scales directly — the
+                    # PR 6 wire triple, no dequant/requant round trip.
+                    src = (
+                        "qdata",
+                        self._host_k[idx].copy(),
+                        self._host_v[idx].copy(),
+                        self._host_k_scale[idx].copy(),
+                        self._host_v_scale[idx].copy(),
+                    )
+                else:
+                    src = (
+                        "data",
+                        self._host_k[idx].copy(),
+                        self._host_v[idx].copy(),
+                    )
+        self._pending_demotions.append((info, src))
+
+    def _build_demotions(self, page_data: dict) -> None:
+        """Resolve queued demotions against the flush gather and hand the
+        wire-ready payloads to ``on_demotion`` (serving-layer pusher)."""
+        from ..kvcache.transfer.protocol import BlockPayload
+
+        cfg = self.model_cfg
+        ps = self.page_size
+        shape = (cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
+        np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
+        quantize_wire = self.config.kv_quant == "int8"
+        payloads = []
+        for info, src in self._pending_demotions:
+            extra = {}
+            if src[0] == "qdata":
+                kd, vd = src[1], src[2]
+                extra = {
+                    "quant": "int8",
+                    "k_scale": src[3].tobytes(),
+                    "v_scale": src[4].tobytes(),
+                }
+            else:
+                kd, vd = (
+                    page_data[src[1]] if src[0] == "page" else (src[1], src[2])
+                )
+                if quantize_wire:
+                    kd, sk = quant.quantize_kv_page(kd)
+                    vd, sv = quant.quantize_kv_page(vd)
+                    extra = {
+                        "quant": "int8",
+                        "k_scale": sk.tobytes(),
+                        "v_scale": sv.tobytes(),
+                    }
+            payloads.append(
+                BlockPayload(
+                    block_hash=info.chain_hash,
+                    parent_block_hash=info.parent_hash,
+                    token_ids=list(info.token_ids),
+                    block_size=ps,
+                    dtype=str(np_dtype) if quantize_wire else str(kd.dtype),
+                    shape=shape,
+                    k_data=kd.tobytes(),
+                    v_data=vd.tobytes(),
+                    **extra,
+                )
+            )
+        self._pending_demotions.clear()
+        self.remote_stats["demoted_blocks"] += len(payloads)
+        self.remote_stats["demote_batches"] += 1
+        sink = self.on_demotion
+        if sink is not None:
+            sink(payloads)
+
+    def accept_remote_blocks(self, source_pod: str, payloads) -> tuple[int, int]:
+        """Commit a peer's demotion push into this pod's remote store and
+        flush the resulting ``BlockStored(medium="remote")`` events so the
+        index learns the new holder without waiting for engine traffic.
+        Returns ``(accepted, headroom)``. Must run on the engine thread
+        (the store shares the event stream's ordering)."""
+        if self.remote_store is None:
+            return 0, 0
+        accepted = self.remote_store.accept(payloads)
+        if accepted:
+            self.remote_stats["accepted_blocks"] += accepted
+        return accepted, self.remote_store.headroom
+
+    @property
+    def remote_headroom(self) -> Optional[int]:
+        """Pages the remote store will still accept (heartbeat headroom
+        advertisement); None when the tier is off — the heartbeat then
+        carries no headroom field and its bytes stay legacy."""
+        if not self.config.remote_tier:
+            return None
+        # `is not None`, not truthiness: the store defines __len__ and an
+        # EMPTY store is exactly when headroom is largest.
+        return (
+            self.remote_store.headroom if self.remote_store is not None else 0
+        )
+
+    def block_digest(self) -> dict[str, list[int]]:
+        """Resync digest across every tier this pod holds, including the
+        remote store — an ``IndexSnapshot`` replace-all must never wipe
+        the demoted entries the holder is responsible for."""
+        digest = self.block_manager.block_digest()
+        if self.remote_store is not None and len(self.remote_store):
+            digest["remote"] = self.remote_store.hashes()
+        return digest
+
     @staticmethod
     def _ema(prev: Optional[float], sample: float, alpha: float = 0.3) -> float:
         return sample if prev is None else (1 - alpha) * prev + alpha * sample
@@ -575,12 +752,21 @@ class Engine:
             self.last_prefetch = (pages, t0, time.monotonic())
 
     def _flush_page_moves(self) -> None:
-        if not self._pending_offloads and not self._pending_restores:
+        if (
+            not self._pending_offloads
+            and not self._pending_restores
+            and not self._pending_demotions
+        ):
             return
         t_flush = time.perf_counter() if self.obs_step_timing else 0.0
-        # One batched gather for every device page any queued move reads.
+        # One batched gather for every device page any queued move reads
+        # (demotion snapshots ride the same gather as offloads/restores).
         need = []
-        for _, src in self._pending_offloads + self._pending_restores:
+        for _, src in (
+            self._pending_offloads
+            + self._pending_restores
+            + self._pending_demotions
+        ):
             if src[0] == "page" and src[1] not in need:
                 need.append(src[1])
         page_data = {}
@@ -655,6 +841,8 @@ class Engine:
                 n / max(time.perf_counter() - t0, 1e-6),
             )
 
+        if self._pending_demotions:
+            self._build_demotions(page_data)
         self._pending_offloads.clear()
         self._pending_restores.clear()
         self._off_by_slot.clear()
@@ -687,8 +875,20 @@ class Engine:
 
         self._flush_page_moves()
         chain = self.block_manager.lookup_chain(hashes, max_blocks)
+        # Remote-store continuation: a kvstore pod (or a peer holding
+        # demoted blocks) serves the rest of the requested run from its
+        # wire-ready store — same stop-at-first-gap walk, zero device
+        # work. Pure store hits (no local page resident) serve too.
+        remote_tail: list = []
+        if self.remote_store is not None:
+            cap = len(hashes) if max_blocks is None else min(max_blocks, len(hashes))
+            remote_tail = self.remote_store.serve(
+                hashes[len(chain) : cap], cap - len(chain)
+            )
         if not chain:
-            return []
+            if remote_tail:
+                self.transfer_stats["exported_blocks"] += len(remote_tail)
+            return remote_tail
         dev = [(i, idx) for i, (_, _, tier, idx) in enumerate(chain) if tier == "tpu_hbm"]
         page_data: dict[int, tuple[np.ndarray, np.ndarray]] = {}
         if dev:
@@ -753,10 +953,11 @@ class Engine:
                     **extra,
                 )
             )
+        blocks.extend(remote_tail)
         self.transfer_stats["exported_blocks"] += len(blocks)
         return blocks
 
-    def import_kv_blocks(self, blocks) -> int:
+    def import_kv_blocks(self, blocks, allow_evict: Optional[bool] = None) -> int:
         """Install fetched prefix blocks as committed prefix-cache pages.
 
         Each block must extend a resident chain (its parent is the chain
@@ -769,8 +970,17 @@ class Engine:
         exactly like locally-computed cache. ``BlockStored`` events flush
         immediately so the global index learns the new warmth without
         waiting for engine traffic. Returns the number of blocks
-        installed. Must run on the engine thread."""
+        installed. Must run on the engine thread.
+
+        ``allow_evict``: None (default) follows ``config.remote_tier`` —
+        with the remote tier on, an import may recycle evictable LRU
+        pages to make room (the victim spills to host or demotes over
+        the fabric, so the trade is lossless); off keeps the legacy
+        free-pages-only rule."""
         from ..kvcache.kvblock.token_processor import hash_block
+
+        if allow_evict is None:
+            allow_evict = self.config.remote_tier
 
         cfg = self.model_cfg
         ps = self.page_size
@@ -833,7 +1043,7 @@ class Engine:
                 break
             try:
                 page = self.block_manager.install_imported_block(
-                    h, parent, blk.token_ids
+                    h, parent, blk.token_ids, allow_evict=allow_evict
                 )
             except AllocationError:
                 break  # pool full: keep what landed, never evict for imports

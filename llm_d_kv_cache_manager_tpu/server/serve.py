@@ -23,7 +23,10 @@ Config comes from env vars mirroring the reference's online service
 (``TRANSFER_ENDPOINT`` binds this pod's page export service — unset = off;
 ``TRANSFER_MAX_BLOCKS``, ``TRANSFER_TIMEOUT_S``; ``ASYNC_PULL`` +
 ``PULL_WORKERS`` import pulled prefixes in the background instead of
-blocking submission) and the decode fast path (``DECODE_FUSED_SAMPLING``).
+blocking submission), the remote capacity tier (``REMOTE_TIER`` demotes
+last-copy evictions to ``REMOTE_PEERS`` / accepts pushes into a
+``REMOTE_STORE_PAGES``-sized store; ``POD_ROLE=kvstore`` is a dedicated
+holder) and the decode fast path (``DECODE_FUSED_SAMPLING``).
 
 Run: ``python -m llm_d_kv_cache_manager_tpu.server.serve``
 """
@@ -54,6 +57,7 @@ from ..kvcache.transfer import (
     KVTransferClient,
     KVTransferService,
     TransferClientConfig,
+    TransferClientPool,
     TransferError,
     TransferServiceConfig,
 )
@@ -559,6 +563,26 @@ class PodServerConfig:
     #: requests (``pull_source``) and streams tokens; the scorer keeps it
     #: out of prefill placement via the heartbeat role advertisement.
     pod_role: str = "mixed"
+    # -- remote tier (ISSUE 13; all off by default = bit-identical legacy
+    # -- behavior and heartbeat/transfer/KV-event wire bytes) --------------
+    #: master switch: evictions that would destroy the last local copy of
+    #: a chain demote over the transfer fabric instead (pushed to a peer
+    #: with advertised headroom / a ``POD_ROLE=kvstore`` pod), imports may
+    #: recycle evictable pages (victims demote — lossless), heartbeats
+    #: advertise remote-store headroom, and pushes from peers are
+    #: accepted into this pod's remote store.
+    remote_tier: bool = False
+    #: remote-store capacity in pages (how many demoted blocks THIS pod
+    #: holds for peers); 0 accepts nothing. A dedicated kvstore pod sets
+    #: this large. Sizing guidance in docs/operations.md.
+    remote_store_pages: int = 0
+    #: comma-separated transfer endpoints of demotion targets (peer pods
+    #: or kvstore pods). Empty = this pod never demotes (but can still
+    #: accept pushes / serve pull-backs with the knob on).
+    remote_peers: str = ""
+    #: bound on payloads parked for the background pusher; overflow drops
+    #: the OLDEST (coldest) payloads — plain eviction, counted.
+    remote_demote_queue: int = 1024
     # -- fleet self-healing (all off by default = bit-identical legacy) ----
     #: seconds between Heartbeat events (liveness beacon + publisher drop
     #: report for the indexer's dead-pod sweep); 0 = no heartbeats.
@@ -642,6 +666,15 @@ class PodServerConfig:
         cfg.pull_workers = int(os.environ.get("PULL_WORKERS", cfg.pull_workers))
         # Disaggregated serving role (unset/"mixed" = legacy single-tier).
         cfg.pod_role = os.environ.get("POD_ROLE", cfg.pod_role).strip() or "mixed"
+        # Remote tier (unset/0 = off, legacy behavior + wire bytes).
+        cfg.remote_tier = _env_bool("REMOTE_TIER", "0")
+        cfg.remote_store_pages = int(
+            os.environ.get("REMOTE_STORE_PAGES", cfg.remote_store_pages)
+        )
+        cfg.remote_peers = os.environ.get("REMOTE_PEERS", cfg.remote_peers)
+        cfg.remote_demote_queue = int(
+            os.environ.get("REMOTE_DEMOTE_QUEUE", cfg.remote_demote_queue)
+        )
         # Fleet self-healing (0/unset = off, legacy behavior).
         cfg.heartbeat_interval_s = float(
             os.environ.get("HEARTBEAT_INTERVAL_S", cfg.heartbeat_interval_s)
@@ -756,6 +789,12 @@ class PodServerConfig:
         eng.quantize = os.environ.get("QUANTIZE") or None
         # CPU smoke runs (Pallas interpreter mode); never set on real TPU.
         eng.interpret = _env_bool("INTERPRET", "0")
+        # Remote tier reaches the engine (demotion hooks, store, import
+        # eviction ladder) through its own config.
+        eng.remote_tier = cfg.remote_tier
+        eng.remote_store_pages = (
+            cfg.remote_store_pages if cfg.remote_tier else 0
+        )
         return cfg
 
 
@@ -778,11 +817,17 @@ class PodServer:
         pod performs, prefill tokens/s from the engine's own online EMA —
         so the model's pull/cold branches can ever activate."""
         self.config = config or PodServerConfig()
-        if self.config.pod_role not in ("mixed", "prefill", "decode"):
+        if self.config.pod_role not in ("mixed", "prefill", "decode", "kvstore"):
             raise ValueError(
-                f"POD_ROLE must be mixed/prefill/decode, got "
+                f"POD_ROLE must be mixed/prefill/decode/kvstore, got "
                 f"{self.config.pod_role!r}"
             )
+        if self.config.remote_tier and engine is None:
+            # Thread the knob family into the engine config BEFORE the
+            # engine is built (attach points live in its ctor). Injected
+            # engines configure themselves.
+            self.config.engine.remote_tier = True
+            self.config.engine.remote_store_pages = self.config.remote_store_pages
         self._tokenizer = tokenizer
         self.transfer_cost_model = transfer_cost_model
         #: request tracing (OBS_TRACING); a disabled tracer hands out one
@@ -854,7 +899,13 @@ class PodServer:
         # park on a Future) — same ownership rule as request admission.
         self._transfer_exports: deque[tuple[list[int], Optional[int], Future]] = deque()  # guarded_by: _mu|_work
         self._transfer_imports: deque[tuple[list, Future]] = deque()  # guarded_by: _mu|_work
-        self._transfer_clients: dict[str, KVTransferClient] = {}  # guarded_by: _mu|_work
+        #: per-endpoint DEALER reuse shared by pull_prefix, async-pull
+        #: workers and demotion pushes — repeat traffic to one peer rides
+        #: one connected socket (dial/reuse counters on the clients).
+        self._transfer_pool = TransferClientPool(
+            self._transfer_client_config,
+            on_sample=self._observe_transfer_sample,
+        )
         self._transfer_service: Optional[KVTransferService] = None
         self.transfer_pulls = 0  # pulls that imported >= 1 block  # guarded_by: _mu|_work
         self.transfer_pull_failures = 0  # fell back to cold  # guarded_by: _mu|_work
@@ -901,6 +952,26 @@ class PodServer:
         self.snapshots_published = 0  # guarded_by: _mu|_work
         self._self_heal_stop = threading.Event()
         self._self_heal_thread: Optional[threading.Thread] = None
+        # -- remote tier (REMOTE_TIER; off = none of this runs) -------------
+        #: demotion pushes from peers staged for the engine loop (the
+        #: remote store shares the event stream's ordering)
+        self._remote_pushes: deque[tuple[str, list, Future]] = deque()  # guarded_by: _mu|_work
+        #: wire-ready payloads parked for the background pusher
+        self._demote_queue: deque = deque()  # guarded_by: _mu|_work
+        self._demote_thread: Optional[threading.Thread] = None
+        self._demote_stop = threading.Event()
+        #: last push-ack headroom per peer endpoint (None = never heard;
+        #: refreshed on every successful push — the between-heartbeats
+        #: feed for target selection)
+        self._peer_headroom: dict[str, Optional[int]] = {}  # guarded_by: _mu|_work
+        self.demote_pushed_blocks = 0  # guarded_by: _mu|_work
+        self.demote_failed_blocks = 0  # fell back to plain eviction  # guarded_by: _mu|_work
+        self.demote_dropped = 0  # queue overflow (plain eviction)  # guarded_by: _mu|_work
+        self._remote_peers = [
+            p.strip() for p in self.config.remote_peers.split(",") if p.strip()
+        ]
+        if self.config.remote_tier and self._remote_peers:
+            self.engine.on_demotion = self._stage_demotions
         if self.config.transfer_endpoint:
             self._transfer_service = KVTransferService(
                 TransferServiceConfig(
@@ -910,6 +981,15 @@ class PodServer:
                 ),
                 handler=self._serve_export,
                 tracer=self.tracer,
+                # Push acceptance only with the knob on AND a store to
+                # hold the blocks; otherwise pushes answer with the same
+                # tolerant refusal a legacy service gives.
+                push_handler=(
+                    self._serve_push
+                    if self.config.remote_tier
+                    and self.config.remote_store_pages > 0
+                    else None
+                ),
             )
 
     # -- lifecycle ----------------------------------------------------------
@@ -924,6 +1004,12 @@ class PodServer:
         self._thread.start()
         if self._transfer_service is not None:
             self._transfer_service.start()
+        if self.engine.on_demotion is not None:
+            self._demote_stop.clear()
+            self._demote_thread = threading.Thread(
+                target=self._demote_loop, name="kv-demote", daemon=True
+            )
+            self._demote_thread.start()
         if self._publisher is not None and (
             self.config.heartbeat_interval_s > 0
             or self.config.resync_interval_s > 0
@@ -1045,11 +1131,9 @@ class PodServer:
         breaker for — a pull through them would skip straight to cold.
         The disagg planner view aggregates these across the fleet to keep
         suspect exporters out of the prefill hop."""
-        with self._mu:
-            clients = dict(self._transfer_clients)
         return {
             endpoint
-            for endpoint, client in clients.items()
+            for endpoint, client in self._transfer_pool.clients().items()
             if client.breaker is not None and client.breaker.state == "open"
         }
 
@@ -1058,6 +1142,10 @@ class PodServer:
         if self._self_heal_thread is not None:
             self._self_heal_thread.join(timeout=5)
             self._self_heal_thread = None
+        self._demote_stop.set()
+        if self._demote_thread is not None:
+            self._demote_thread.join(timeout=10)
+            self._demote_thread = None
         if self._transfer_service is not None:
             self._transfer_service.shutdown()
         with self._mu:
@@ -1076,11 +1164,7 @@ class PodServer:
             self._thread.join(timeout=30)
             self._thread = None
         self._fail_outstanding(RuntimeError("pod server shut down"))
-        with self._mu:
-            clients = list(self._transfer_clients.values())
-            self._transfer_clients.clear()
-        for client in clients:
-            client.close()
+        self._transfer_pool.close_all()
         if self._publisher is not None:
             self._publisher.close()
 
@@ -1093,10 +1177,13 @@ class PodServer:
             transfers = (
                 list(self._transfer_exports)
                 + list(self._transfer_imports)
+                + list(self._remote_pushes)
                 + [(fut,) for fut in self._digest_requests]
             )
             self._transfer_exports.clear()
             self._transfer_imports.clear()
+            self._remote_pushes.clear()
+            self._demote_queue.clear()
             self._digest_requests.clear()
             self._import_dones.clear()
             jobs = list(self._pull_jobs.values())
@@ -1269,6 +1356,7 @@ class PodServer:
                         or self._aborts
                         or self._transfer_exports
                         or self._transfer_imports
+                        or self._remote_pushes
                         or self._digest_requests
                         or self._import_dones
                         or self.engine.has_ready_work
@@ -1284,6 +1372,8 @@ class PodServer:
                     self._transfer_exports.clear()
                     imports = list(self._transfer_imports)
                     self._transfer_imports.clear()
+                    pushes = list(self._remote_pushes)
+                    self._remote_pushes.clear()
                     digests = list(self._digest_requests)
                     self._digest_requests.clear()
                     import_dones = list(self._import_dones)
@@ -1294,12 +1384,22 @@ class PodServer:
                 # its pull (pull_prefix -> submit) sees the warm pages.
                 for fut in digests:
                     try:
-                        fut.set_result(self.engine.block_manager.block_digest())
+                        # Engine-level digest: every tier incl. the remote
+                        # store (a resync must not wipe demoted entries
+                        # this pod holds for the fleet).
+                        fut.set_result(self.engine.block_digest())
                     except Exception as e:
                         fut.set_exception(e)
                 for blocks, fut in imports:
                     try:
                         fut.set_result(self.engine.import_kv_blocks(blocks))
+                    except Exception as e:
+                        fut.set_exception(e)
+                for source_pod, blocks, fut in pushes:
+                    try:
+                        fut.set_result(
+                            self.engine.accept_remote_blocks(source_pod, blocks)
+                        )
                     except Exception as e:
                         fut.set_exception(e)
                 for hashes, max_blocks, fut in exports:
@@ -1477,6 +1577,9 @@ class PodServer:
                             if self.config.pod_role != "mixed"
                             else None
                         ),
+                        # Remote-store headroom advertisement: None with
+                        # REMOTE_TIER off — heartbeat bytes stay legacy.
+                        headroom=self.engine.remote_headroom,
                     )
                 ]
             )
@@ -1559,29 +1662,121 @@ class PodServer:
             self._work.notify()
         return fut
 
+    def _transfer_client_config(self, endpoint: str) -> TransferClientConfig:
+        """Pool factory: per-peer client config (timeouts + breaker)."""
+        return TransferClientConfig(
+            endpoint=endpoint,
+            timeout_s=self.config.transfer_timeout_s,
+            breaker_failures=self.config.transfer_breaker_failures,
+            breaker_backoff_s=self.config.transfer_breaker_backoff_s,
+            breaker_backoff_max_s=self.config.transfer_breaker_backoff_max_s,
+        )
+
     def _get_client(self, endpoint: str) -> Optional[KVTransferClient]:
-        """Per-peer transfer client (created lazily, breaker-configured).
-        None when the pod is shutting down — a client created after the
-        shutdown sweep would leak its socket."""
-        with self._mu:  # races shutdown's client sweep
+        """Pooled per-peer transfer client (one connected DEALER per
+        endpoint, shared by pulls and demotion pushes). None when the pod
+        is shutting down — a client created after the shutdown sweep
+        would leak its socket."""
+        with self._mu:  # races shutdown's running flip
             if not self._running:
                 return None
-            client = self._transfer_clients.get(endpoint)
+        return self._transfer_pool.get(endpoint)
+
+    # -- remote-tier demotion (REMOTE_TIER) ---------------------------------
+    def _serve_push(self, source_pod: str, blocks: list) -> tuple[int, int]:
+        """KVTransferService push handler (service thread): hop onto the
+        engine loop — the remote store shares the event stream's ordering
+        — and wait for the commit verdict."""
+        fut: Future = Future()
+        with self._work:
+            if not self._running or self._failed is not None:
+                return 0, 0
+            self._remote_pushes.append((source_pod, blocks, fut))
+            self._work.notify()
+        return fut.result(timeout=max(self.config.transfer_timeout_s * 3, 30.0))
+
+    def _stage_demotions(self, payloads: list) -> None:
+        """``Engine.on_demotion`` sink (engine loop): park wire-ready
+        payloads for the background pusher. Bounded — overflow drops the
+        OLDEST (coldest) payloads, which is exactly the plain eviction
+        that would have happened without the tier, counted so a pusher
+        that cannot keep up is visible rather than a memory leak."""
+        dropped = 0
+        with self._mu:
+            self._demote_queue.extend(payloads)
+            cap = max(self.config.remote_demote_queue, 1)
+            while len(self._demote_queue) > cap:
+                self._demote_queue.popleft()
+                dropped += 1
+            if dropped:
+                self.demote_dropped += dropped
+
+    def _demotion_targets(self) -> list[str]:
+        """Peers ordered most-headroom-first (unknown counts as open-ended
+        — optimistic until the first ack says otherwise), skipping only
+        peers whose circuit breaker is OPEN (a push would fail instantly).
+        A peer that last acked ZERO headroom ranks last but stays a
+        target: a full remote store still accepts by LRU-rotating its
+        coldest blocks, and the next ack refreshes the number — skipping
+        it outright would permanently turn demotion off the first time
+        the holder filled."""
+        with self._mu:
+            headroom = dict(self._peer_headroom)
+        open_eps = self.open_breaker_endpoints
+        ranked = []
+        for ep in self._remote_peers:
+            if ep in open_eps:
+                continue
+            h = headroom.get(ep)
+            ranked.append((-(h if h is not None else 1 << 30), ep))
+        ranked.sort()
+        return [ep for _, ep in ranked]
+
+    def _demote_loop(self) -> None:
+        """Background pusher: drain parked demotions to the best target.
+        EVERY failure path is plain eviction (the legacy outcome) — a
+        partitioned or dead target costs bounded timeouts (then breaker
+        fast-fails), never a stalled engine or a wedged shutdown."""
+        while not self._demote_stop.wait(0.02):
+            with self._mu:
+                if not self._demote_queue:
+                    continue
+                batch = []
+                cap = max(self.config.transfer_max_blocks, 1)
+                while self._demote_queue and len(batch) < cap:
+                    batch.append(self._demote_queue.popleft())
+            self._push_batch(batch)
+
+    def _push_batch(self, batch: list) -> None:
+        for endpoint in self._demotion_targets():
+            client = self._get_client(endpoint)
             if client is None:
-                client = KVTransferClient(
-                    TransferClientConfig(
-                        endpoint=endpoint,
-                        timeout_s=self.config.transfer_timeout_s,
-                        breaker_failures=self.config.transfer_breaker_failures,
-                        breaker_backoff_s=self.config.transfer_breaker_backoff_s,
-                        breaker_backoff_max_s=(
-                            self.config.transfer_breaker_backoff_max_s
-                        ),
-                    ),
-                    on_sample=self._observe_transfer_sample,
+                break  # shutting down; drop = plain eviction
+            try:
+                accepted, headroom = client.push_blocks(
+                    self.config.model_name,
+                    self.config.pod_identifier,
+                    batch,
+                    timeout_s=self.config.transfer_timeout_s,
                 )
-                self._transfer_clients[endpoint] = client
-        return client
+            except TransferError as e:
+                log.warning(
+                    "demotion push failed; trying next peer",
+                    target=endpoint,
+                    blocks=len(batch),
+                    error=repr(e),
+                )
+                continue
+            with self._mu:
+                self._peer_headroom[endpoint] = headroom
+                self.demote_pushed_blocks += accepted
+                if accepted < len(batch):
+                    # Validation rejects / duplicate holds: the remainder
+                    # is plainly evicted, same as legacy.
+                    self.demote_failed_blocks += len(batch) - accepted
+            return
+        with self._mu:
+            self.demote_failed_blocks += len(batch)
 
     # -- async prefix import (ASYNC_PULL) -----------------------------------
     def _start_async_pull(self, seq: Sequence, source: str, span) -> None:
@@ -1896,6 +2091,12 @@ class PodServer:
         # add_request applies (the rest raise through the Future).
         if not prompt_tokens:
             raise ValueError("empty prompt")
+        if self.config.pod_role == "kvstore":
+            # A kvstore pod is storage, not compute: it holds demoted
+            # blocks and serves transfer pulls; its heartbeat role keeps
+            # it out of every scorer placement, and a misrouted submit
+            # fails loudly instead of silently burning its pages.
+            raise ValueError("kvstore pods do not serve requests")
         clamped = False
         if self.config.pod_role == "prefill":
             # Role gate at admission: a prefill-tier pod runs ingest at
@@ -2198,14 +2399,14 @@ class PodServer:
                 staged = len(self._staging)
                 pending = self._pending
                 pending_tokens = self._pending_tokens
+                clients = self._transfer_pool.clients()
                 breakers = {
                     ep: client.breaker.snapshot()
-                    for ep, client in self._transfer_clients.items()
+                    for ep, client in clients.items()
                     if client.breaker is not None
                 }
                 breaker_skips = sum(
-                    client.breaker_skips
-                    for client in self._transfer_clients.values()
+                    client.breaker_skips for client in clients.values()
                 )
                 pulls = self.transfer_pulls
                 pull_failures = self.transfer_pull_failures
@@ -2223,6 +2424,11 @@ class PodServer:
                 role_clamped = self.role_clamped_requests
                 prefill_completes = self.prefill_completes_published
                 audits_published = self.audits_published
+                demote_pushed = self.demote_pushed_blocks
+                demote_failed = self.demote_failed_blocks
+                demote_dropped = self.demote_dropped
+                demote_queued = len(self._demote_queue)
+                peer_headroom = dict(self._peer_headroom)
             payload = {
                 "pod": self.config.pod_identifier,
                 "model": self.config.model_name,
@@ -2289,6 +2495,33 @@ class PodServer:
                     "pulls": async_pulls,
                     "fallbacks": async_fallbacks,
                     "canceled": async_canceled,
+                }
+            if self.config.remote_tier:
+                # Remote-tier block only with the knob on: the knobs-off
+                # /stats payload stays bit-identical.
+                store = self.engine.remote_store
+                payload["remote"] = {
+                    "peers": list(self._remote_peers),
+                    "store_pages": self.config.remote_store_pages,
+                    "store_cached": len(store) if store is not None else 0,
+                    "headroom": self.engine.remote_headroom,
+                    "peer_headroom": peer_headroom,
+                    **dict(self.engine.remote_stats),
+                    "pushed_blocks": demote_pushed,
+                    "push_failed_blocks": demote_failed,
+                    "queue_dropped": demote_dropped,
+                    "queued": demote_queued,
+                    "store_stats": (
+                        dict(store.stats) if store is not None else {}
+                    ),
+                    "pushes_served": (
+                        self._transfer_service.pushes_served
+                        if self._transfer_service
+                        else 0
+                    ),
+                    # Connection reuse on the shared client pool (pulls +
+                    # demotion pushes ride the same DEALER per peer).
+                    "clients": self._transfer_pool.snapshot(),
                 }
             if bm.config.host_pages > 0:
                 # Host tier + KV quant block only when the tier knob is on:

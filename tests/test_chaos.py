@@ -698,7 +698,7 @@ class TestEngineFleetChaos:
             assert first >= 0.35 and second < 0.2
             assert cold.transfer_pull_failures == 2
 
-            client = cold._transfer_clients[peer]
+            client = cold._transfer_pool.clients()[peer]
             assert client.breaker is not None
             assert client.breaker.snapshot()["state"] == "open"
             assert client.breaker_skips == 1

@@ -608,7 +608,7 @@ class TestDisaggChaos:
             # No self-pull: the continuation was served from the pod's own
             # already-local chain, never through the transfer plane.
             assert pods["m0"].transfer_pulls == 0
-            assert not pods["m0"]._transfer_clients
+            assert not pods["m0"]._transfer_pool.clients()
 
     def test_dead_pod_on_single_mode_plan_replans(self):
         # A mode="single" plan (all-mixed fleet, no exporter) participates

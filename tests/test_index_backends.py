@@ -135,6 +135,38 @@ class TestIndexConformance:
         assert index.evict_pod("podA") == 2
         assert index.lookup([_k(1)], set()).get(_k(1), []) == []
 
+    def test_evict_pod_remote_tier_keyed_to_holder(self, index):
+        """Remote-tier death semantics (ISSUE 13): demoted entries are
+        keyed to the HOLDER pod (the kvstore/peer storing the bytes), so
+        the DEMOTER dying keeps them and the holder dying drops them —
+        across every backend (and ShardedIndex, which reruns this suite).
+        """
+        index.add([_k(1)], [_e("demoter", DeviceTier.TPU_HBM)])
+        index.add(
+            [_k(1), _k(2)], [_e("kv-holder", DeviceTier.REMOTE)]
+        )
+        # The demoter's death never touches the holder's remote entries.
+        assert index.evict_pod("demoter") == 1
+        got = index.lookup([_k(1), _k(2)], set())
+        assert got[_k(1)] == ["kv-holder"]
+        assert got[_k(2)] == ["kv-holder"]
+        # The holder's death drops exactly the entries whose bytes died.
+        assert index.evict_pod("kv-holder") == 2
+        got = index.lookup([_k(1), _k(2)], set())
+        assert got.get(_k(1), []) == [] and got.get(_k(2), []) == []
+
+    def test_evict_remote_tier_entry_by_medium(self, index):
+        """A holder's BlockRemoved(remote) (store LRU drop) evicts the
+        REMOTE-tier entry without touching its other tiers."""
+        index.add(
+            [_k(1)],
+            [_e("pod", DeviceTier.TPU_HBM), _e("pod", DeviceTier.REMOTE)],
+        )
+        index.evict(_k(1), [_e("pod", DeviceTier.REMOTE)])
+        assert index.lookup([_k(1)], set())[_k(1)] == ["pod"]
+        index.evict(_k(1), [_e("pod", DeviceTier.TPU_HBM)])
+        assert index.lookup([_k(1)], set()).get(_k(1), []) == []
+
     def test_evict_pod_unknown_is_noop(self, index):
         index.add([_k(1)], [_e("podA")])
         assert index.evict_pod("never-seen") == 0

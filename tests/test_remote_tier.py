@@ -1,0 +1,976 @@
+"""Remote-tier suite (ISSUE 13 acceptance): eviction as demotion.
+
+- **Push protocol**: ``PushBlocks``/``PushAck`` round-trips (incl. the
+  int8 quant triple), tolerant garbage handling, and the legacy frames'
+  byte-for-byte stability (old services answer pushes with an error the
+  pusher treats as "plain eviction").
+- **Remote store**: validated accept (geometry + chain-hash
+  self-consistency; tampered tokens and truncated scale triples register
+  nothing), LRU capacity with ``BlockRemoved(remote)`` goodbyes,
+  stop-at-first-gap serving.
+- **Heartbeat headroom**: trailing-append wire field; role-less,
+  headroom-less heartbeat bytes pinned bit-identical legacy; the new
+  ``kvstore`` role round-trips and is excluded from EVERY scorer
+  placement.
+- **Demotion**: both eviction paths (HBM recycle + host-LRU drop) hand
+  wire-ready payloads to the sink; knob off = no hook, bit-identical
+  behavior; demote→pull-back greedy parity vs never-evicted; imports may
+  recycle evictable pages only under the knob.
+- **Chaos**: a partitioned demotion target degrades to plain eviction
+  (generation completes, pages back to baseline, no stall); a tampered
+  push over the real ZMQ fabric is rejected before anything registers.
+- **Index semantics**: remote entries are keyed to the HOLDER pod, so
+  ``evict_pod`` of the demoter keeps them and of the holder drops them
+  (the conformance case lives in test_index_backends.py and runs across
+  all five backends + ``ShardedIndex``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents.events import (
+    EventBatch,
+    Heartbeat,
+    decode_event_batch,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents.health import (
+    FleetHealth,
+    FleetHealthConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.router import (
+    BlendedRouter,
+    PrefixAffinityTracker,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.transfer import (
+    BlockPayload,
+    KVTransferClient,
+    RemoteBlockStore,
+    RemoteStoreConfig,
+    TransferClientConfig,
+    TransferCostModel,
+    TransferCostModelConfig,
+    TransferError,
+    protocol,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.token_processor import (
+    hash_block,
+)
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, quant
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    Engine,
+    EngineConfig,
+    SamplingParams,
+    SchedulerConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.serve import PodServer, PodServerConfig
+
+PS = 4
+MODEL = "tiny-llama"
+SHAPE = (TINY_LLAMA.n_layers, PS, TINY_LLAMA.n_kv_heads, TINY_LLAMA.hd)
+SCALE_BYTES = int(np.prod(quant.kv_scale_shape(SHAPE))) * 4
+
+
+def _engine_cfg(total_pages=64, **kw):
+    return EngineConfig(
+        model=TINY_LLAMA,
+        block_manager=BlockManagerConfig(
+            total_pages=total_pages,
+            page_size=PS,
+            host_pages=kw.pop("host_pages", 0),
+        ),
+        scheduler=SchedulerConfig(max_prefill_batch=4),
+        max_model_len=64,
+        decode_batch_size=4,
+        prefill_bucket=8,
+        interpret=True,
+        **kw,
+    )
+
+
+def _engine(total_pages=64, on_events=None, **kw):
+    return Engine(_engine_cfg(total_pages=total_pages, **kw), on_events=on_events)
+
+
+def _prompt(seed, n):
+    return list(
+        map(int, np.random.default_rng(seed).integers(0, TINY_LLAMA.vocab_size, n))
+    )
+
+
+def _store(capacity=64, init_hash=0, on_events=None, dtype="float32"):
+    return RemoteBlockStore(
+        RemoteStoreConfig(
+            capacity_pages=capacity,
+            page_size=PS,
+            page_shape=SHAPE,
+            dtype=dtype,
+            scale_bytes=SCALE_BYTES,
+            init_hash=init_hash,
+        ),
+        on_events=on_events,
+    )
+
+
+def _chain_payloads(init_hash, n=3, seed=0, dtype="float32"):
+    """A self-consistent chain of n payload blocks with real hashes."""
+    rng = np.random.default_rng(seed)
+    parent = None
+    out = []
+    data = np.zeros(SHAPE, np.dtype(dtype)).tobytes()
+    for i in range(n):
+        toks = [int(t) for t in rng.integers(0, 1000, PS)]
+        h = hash_block(parent if parent is not None else init_hash, toks)
+        out.append(
+            BlockPayload(
+                block_hash=h,
+                parent_block_hash=parent,
+                token_ids=toks,
+                block_size=PS,
+                dtype=dtype,
+                shape=SHAPE,
+                k_data=data,
+                v_data=data,
+            )
+        )
+        parent = h
+    return out
+
+
+def _pod_config(pod_id, transfer_endpoint=None, total_pages=64, **kw):
+    return PodServerConfig(
+        model_name=MODEL,
+        pod_identifier=pod_id,
+        publish_events=False,
+        transfer_endpoint=transfer_endpoint,
+        engine=_engine_cfg(total_pages=total_pages),
+        **kw,
+    )
+
+
+class TestPushProtocol:
+    def test_push_round_trip(self):
+        blocks = _chain_payloads(7, n=2)
+        enc = protocol.encode_push("m", "pod-src", blocks)
+        model, src, got = protocol.decode_push(enc)
+        assert model == "m" and src == "pod-src"
+        assert [b.block_hash for b in got] == [b.block_hash for b in blocks]
+        assert got[0].token_ids == blocks[0].token_ids
+
+    def test_push_round_trip_quant_triple(self):
+        b = _chain_payloads(7, n=1)[0]
+        b.quant = "int8"
+        b.k_data = b.v_data = b"\x01" * int(np.prod(SHAPE))
+        b.k_scale = b.v_scale = b"\x00" * SCALE_BYTES
+        _, _, got = protocol.decode_push(protocol.encode_push("m", "s", [b]))
+        assert got[0].quant == "int8"
+        assert len(got[0].k_scale) == SCALE_BYTES
+
+    def test_ack_round_trip(self):
+        assert protocol.decode_push_ack(protocol.encode_push_ack(3, 9)) == (
+            3,
+            9,
+            None,
+        )
+
+    def test_error_decodes_as_refusal(self):
+        acc, hr, err = protocol.decode_push_ack(protocol.encode_error("no"))
+        assert (acc, hr) == (0, 0) and err == "no"
+
+    def test_garbage_decodes_to_none(self):
+        assert protocol.decode_push(b"\x01\x02") is None
+        assert protocol.decode_push_ack(b"\x01\x02") is None
+
+    def test_push_is_not_a_fetch_and_vice_versa(self):
+        push = protocol.encode_push("m", "s", _chain_payloads(7, n=1))
+        fetch = protocol.encode_request("m", [1, 2])
+        assert protocol.decode_request(push) is None
+        assert protocol.decode_push(fetch) is None
+
+    def test_legacy_response_bytes_unchanged(self):
+        """The block-row refactor (shared by Blocks and PushBlocks) must
+        not move a byte of the legacy response wire format."""
+        import msgpack
+
+        b = _chain_payloads(7, n=1)[0]
+        expect = msgpack.packb(
+            [
+                "Blocks",
+                True,
+                [
+                    [
+                        b.block_hash,
+                        b.parent_block_hash,
+                        list(b.token_ids),
+                        b.block_size,
+                        b.dtype,
+                        list(b.shape),
+                        b.k_data,
+                        b.v_data,
+                    ]
+                ],
+            ],
+            use_bin_type=True,
+        )
+        assert protocol.encode_response([b], True) == expect
+
+
+class TestRemoteStore:
+    def test_accept_and_serve_round_trip(self):
+        store = _store(init_hash=7)
+        chain = _chain_payloads(7, n=3)
+        assert store.accept(chain) == 3
+        hashes = [b.block_hash for b in chain]
+        assert [b.block_hash for b in store.serve(hashes)] == hashes
+        assert store.stats["accepted"] == 3 and store.stats["served"] == 3
+
+    def test_serve_stops_at_first_gap(self):
+        store = _store(init_hash=7)
+        chain = _chain_payloads(7, n=3)
+        store.accept([chain[0], chain[2]])  # hole at block 1
+        hashes = [b.block_hash for b in chain]
+        assert [b.block_hash for b in store.serve(hashes)] == [hashes[0]]
+
+    def test_tampered_tokens_rejected(self):
+        store = _store(init_hash=7)
+        b = _chain_payloads(7, n=1)[0]
+        b.token_ids = list(b.token_ids)
+        b.token_ids[0] ^= 1
+        assert store.accept([b]) == 0
+        assert store.stats["rejected"] == 1 and len(store) == 0
+
+    def test_truncated_scale_triple_rejected(self):
+        store = _store(init_hash=7)
+        b = _chain_payloads(7, n=1)[0]
+        b.quant = "int8"
+        b.k_data = b.v_data = b"\x01" * int(np.prod(SHAPE))
+        b.k_scale = b"\x00" * (SCALE_BYTES - 4)  # truncated
+        b.v_scale = b"\x00" * SCALE_BYTES
+        assert store.accept([b]) == 0
+        assert store.stats["rejected"] == 1
+
+    def test_wrong_geometry_rejected(self):
+        store = _store(init_hash=7)
+        b = _chain_payloads(7, n=1)[0]
+        b.block_size = PS * 2
+        assert store.accept([b]) == 0
+
+    def test_lru_capacity_with_remote_goodbyes(self):
+        events = []
+        store = _store(capacity=2, init_hash=7, on_events=events.extend)
+        chain = _chain_payloads(7, n=3)
+        assert store.accept(chain) == 3
+        assert len(store) == 2 and store.stats["evicted"] == 1
+        assert store.headroom == 0
+        stored = [e for e in events if type(e).__name__ == "BlockStored"]
+        removed = [e for e in events if type(e).__name__ == "BlockRemoved"]
+        assert len(stored) == 3 and len(removed) == 1
+        assert all(e.medium == "remote" for e in stored + removed)
+        assert removed[0].block_hashes == [chain[0].block_hash]
+
+    def test_duplicate_accept_refreshes_recency(self):
+        store = _store(capacity=2, init_hash=7)
+        chain = _chain_payloads(7, n=2)
+        store.accept(chain)
+        store.accept([chain[0]])  # touch block 0 to MRU
+        extra = _chain_payloads(7, n=1, seed=9)
+        store.accept(extra)  # evicts block 1, not block 0
+        assert chain[0].block_hash in store
+        assert chain[1].block_hash not in store
+
+    def test_zero_capacity_accepts_nothing(self):
+        store = _store(capacity=0, init_hash=7)
+        assert store.accept(_chain_payloads(7, n=1)) == 0
+
+
+class TestHeartbeatHeadroom:
+    def test_legacy_heartbeat_bytes_pinned(self):
+        import msgpack
+
+        payload = EventBatch(ts=1.5, events=[Heartbeat(dropped_batches=5)])
+        assert payload.to_payload() == msgpack.packb(
+            [1.5, [["Heartbeat", 5]]], use_bin_type=True
+        )
+
+    def test_role_heartbeat_bytes_pinned(self):
+        import msgpack
+
+        payload = EventBatch(
+            ts=0.0, events=[Heartbeat(0, role="prefill")]
+        ).to_payload()
+        assert payload == msgpack.packb(
+            [0.0, [["Heartbeat", 0, False, "prefill"]]], use_bin_type=True
+        )
+
+    def test_headroom_round_trip_roleless(self):
+        hb = decode_event_batch(
+            EventBatch(ts=0.0, events=[Heartbeat(1, headroom=42)]).to_payload()
+        ).events[0]
+        assert hb.headroom == 42
+        assert hb.role is None  # the "mixed" filler decodes back to None
+        assert hb.draining is False
+
+    def test_headroom_round_trip_kvstore_role(self):
+        hb = decode_event_batch(
+            EventBatch(
+                ts=0.0, events=[Heartbeat(0, role="kvstore", headroom=7)]
+            ).to_payload()
+        ).events[0]
+        assert hb.role == "kvstore" and hb.headroom == 7
+
+    def test_bad_headroom_tolerated(self):
+        import msgpack
+
+        raw = msgpack.packb(
+            [0.0, [["Heartbeat", 0, False, "mixed", "junk"]]],
+            use_bin_type=True,
+        )
+        hb = decode_event_batch(raw).events[0]
+        assert hb.headroom is None and hb.role is None
+
+
+class TestHealthKvstore:
+    def test_kvstore_excluded_from_every_placement(self):
+        fh = FleetHealth(FleetHealthConfig())
+        fh.observe_heartbeat("kv-0", 0, role="kvstore", headroom=9)
+        scores = {"kv-0": 10, "pod-a": 2}
+        for placement in (None, "prefill", "decode"):
+            out = fh.filter_scores(dict(scores), placement)
+            assert "kv-0" not in out and out["pod-a"] == 2, placement
+
+    def test_pull_source_placement_keeps_kvstore_scorable(self):
+        """The remote read path: a FleetHealth-wired scorer must answer a
+        holder-only query (the serving filter rightly blanks kvstore pods
+        from every OTHER placement) — without this the bring-back arm
+        could never fire in a production-wired fleet."""
+        fh = FleetHealth(FleetHealthConfig())
+        fh.observe_heartbeat("kv-0", 0, role="kvstore", headroom=9)
+        scores = {"kv-0": 10}
+        assert fh.filter_scores(dict(scores), "pull_source") == scores
+        # Liveness still gates pull sources: a drained holder's bytes are
+        # gone, pulling from it would just burn the timeout.
+        fh.observe_drained("kv-0")
+        assert fh.filter_scores(dict(scores), "pull_source") == {}
+
+    def test_roleblind_fast_path_without_kvstore(self):
+        fh = FleetHealth(FleetHealthConfig())
+        fh.observe_heartbeat("pod-a", 0, role="prefill")
+        scores = {"pod-a": 3, "pod-b": 1}
+        # placement=None stays role-blind on kvstore-less fleets (prefill
+        # pods remain eligible — the legacy contract).
+        assert fh.filter_scores(dict(scores), None) == scores
+
+    def test_headroom_tracking_and_targets(self):
+        fh = FleetHealth(FleetHealthConfig())
+        fh.observe_heartbeat("kv-0", 0, role="kvstore", headroom=16)
+        fh.observe_heartbeat("pod-a", 0, headroom=4)
+        fh.observe_heartbeat("pod-b", 0)  # never advertised
+        assert fh.headroom_of("kv-0") == 16
+        assert fh.headroom_of("pod-b") is None
+        assert fh.remote_targets() == {"kv-0": 16, "pod-a": 4}
+        # A draining pod stops being a target.
+        fh.observe_heartbeat("pod-a", 0, draining=True, headroom=4)
+        assert "pod-a" not in fh.remote_targets()
+
+    def test_headroom_absence_keeps_last_value(self):
+        fh = FleetHealth(FleetHealthConfig())
+        fh.observe_heartbeat("pod-a", 0, headroom=8)
+        fh.observe_heartbeat("pod-a", 0)  # legacy heartbeat, no field
+        assert fh.headroom_of("pod-a") == 8
+
+
+class TestCostModelRemote:
+    def _model(self, **kw):
+        return TransferCostModel(
+            TransferCostModelConfig(block_bytes=1000, block_size=PS, **kw)
+        )
+
+    def test_abstains_until_rates_measured(self):
+        m = self._model()
+        assert m.decide_remote(100, 8, 0.0) == "route_warm"
+        m.seed_rates(transfer_bytes_s=1e9)
+        assert m.decide_remote(100, 8, 0.0) == "route_warm"
+
+    def test_pull_beats_recompute_on_fast_link(self):
+        m = self._model()
+        m.seed_rates(transfer_bytes_s=1e9, prefill_tokens_s=100.0)
+        assert m.decide_remote(33, 8, target_load=0.0) == "pull"
+
+    def test_slow_link_falls_back_to_recompute(self):
+        m = self._model()
+        m.seed_rates(transfer_bytes_s=100.0, prefill_tokens_s=1e6)
+        assert m.decide_remote(33, 8, target_load=0.0) == "route_warm"
+
+    def test_warm_local_hit_wins(self):
+        m = self._model()
+        m.seed_rates(transfer_bytes_s=1e9, prefill_tokens_s=100.0)
+        # Local pod already holds the whole usable prefix: nothing to move.
+        assert (
+            m.decide_remote(33, 8, target_load=0.0, warm_blocks=8, warm_load=0.0)
+            == "route_warm"
+        )
+
+
+class TestRouterRemoteArm:
+    def _router(self, scores, remote, loads=(0.0, 0.0), cost_model=None):
+        return BlendedRouter(
+            score_fn=lambda toks, pods: dict(scores),
+            affinity=PrefixAffinityTracker(2, capacity_blocks=64),
+            loads_fn=lambda pods: list(loads),
+            cost_model=cost_model,
+            remote_score_fn=(lambda toks: dict(remote)) if remote is not None else None,
+            remote_endpoint_of=lambda p: f"tcp://{p}:5558",
+        )
+
+    def _cm(self):
+        m = TransferCostModel(
+            TransferCostModelConfig(block_bytes=1000, block_size=PS)
+        )
+        m.seed_rates(transfer_bytes_s=1e9, prefill_tokens_s=100.0)
+        return m
+
+    def test_remote_pull_fires_on_cold_fleet(self):
+        r = self._router({"p0": 0, "p1": 0}, {"kv-0": 9}, cost_model=self._cm())
+        d = r.route(list(range(40)), ["p0", "p1"])
+        assert d.action == "pull"
+        assert d.pull_source == "tcp://kv-0:5558" and d.pull_blocks == 9
+
+    def test_local_warmth_dominates_equal_remote(self):
+        r = self._router({"p0": 9, "p1": 0}, {"kv-0": 9}, cost_model=self._cm())
+        d = r.route(list(range(40)), ["p0", "p1"])
+        assert d.action == "route_warm" and d.pod == "p0"
+
+    def test_no_cost_model_keeps_legacy(self):
+        r = self._router({"p0": 0, "p1": 0}, {"kv-0": 9}, cost_model=None)
+        d = r.route(list(range(40)), ["p0", "p1"])
+        assert d.action == "route_warm" and d.pull_source is None
+
+    def test_no_remote_fn_is_legacy(self):
+        r = BlendedRouter(
+            score_fn=lambda toks, pods: {"p0": 0, "p1": 0},
+            affinity=PrefixAffinityTracker(2, capacity_blocks=64),
+            loads_fn=lambda pods: [0.0, 0.0],
+            cost_model=self._cm(),
+        )
+        d = r.route(list(range(40)), ["p0", "p1"])
+        assert d.action == "route_warm"
+
+
+class TestDemotionEngine:
+    def test_knob_off_no_hook(self):
+        eng = _engine(total_pages=12)
+        assert eng.block_manager._demote is None
+        assert eng.remote_store is None and eng.remote_headroom is None
+
+    def test_hbm_eviction_demotes_last_copy(self):
+        eng = _engine(total_pages=12, remote_tier=True)
+        payloads = []
+        eng.on_demotion = payloads.extend
+        for i in range(4):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        assert payloads and eng.remote_stats["demoted_blocks"] == len(payloads)
+        # Every payload is self-consistent: a fresh store accepts it all.
+        store = _store(
+            capacity=256, init_hash=eng.block_manager.token_db.init_hash
+        )
+        assert store.accept(payloads) == len(
+            {b.block_hash for b in payloads}
+        )
+        assert store.stats["rejected"] == 0
+
+    def test_no_sink_means_plain_eviction(self):
+        eng = _engine(total_pages=12, remote_tier=True)  # on_demotion unset
+        for i in range(4):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        assert eng.remote_stats["demoted_blocks"] == 0
+
+    def test_outputs_identical_knob_on_vs_off(self):
+        outs = {}
+        for remote in (False, True):
+            eng = _engine(total_pages=12, remote_tier=remote)
+            eng.on_demotion = lambda ps: None
+            got = []
+            for i in range(4):
+                seq = eng.add_request(
+                    _prompt(i, 16), SamplingParams(max_new_tokens=4)
+                )
+                eng.run_until_complete()
+                got.append(list(seq.generated_tokens))
+            outs[remote] = got
+        assert outs[False] == outs[True]
+
+    def test_host_lru_drop_demotes(self):
+        # Tiny host tier: spills land there, then host-LRU drops demote.
+        eng = _engine(
+            total_pages=12,
+            remote_tier=True,
+            host_pages=2,
+            host_tier_policy="always",
+        )
+        payloads = []
+        eng.on_demotion = payloads.extend
+        for i in range(5):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        assert eng.block_manager.host_stats["host_evicted"] > 0
+        assert payloads
+        store = _store(
+            capacity=256, init_hash=eng.block_manager.token_db.init_hash
+        )
+        store.accept(payloads)
+        assert store.stats["rejected"] == 0
+
+    def test_int8_demotion_ships_quant_triple(self):
+        eng = _engine(total_pages=12, remote_tier=True, kv_quant="int8")
+        payloads = []
+        eng.on_demotion = payloads.extend
+        for i in range(4):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        assert payloads
+        assert all(b.quant == "int8" for b in payloads)
+        assert all(len(b.k_scale) == SCALE_BYTES for b in payloads)
+        # Quantized payloads are ~half the wire bytes of full fp32 pages.
+        full = 2 * int(np.prod(SHAPE)) * 4
+        assert all(b.wire_bytes < full * 0.6 for b in payloads)
+        store = _store(
+            capacity=256, init_hash=eng.block_manager.token_db.init_hash
+        )
+        store.accept(payloads)
+        assert store.stats["rejected"] == 0
+
+    def test_demote_pull_back_greedy_parity(self):
+        """The round trip the tier exists for: evict→demote→store→pull
+        back→serve warm, token-identical to a never-evicted engine."""
+        base = _engine(total_pages=64)
+        want = {}
+        for i in range(5):
+            seq = base.add_request(
+                _prompt(i, 16), SamplingParams(max_new_tokens=4)
+            )
+            base.run_until_complete()
+            want[i] = list(seq.generated_tokens)
+
+        eng = _engine(total_pages=12, remote_tier=True)
+        store = _store(
+            capacity=256, init_hash=eng.block_manager.token_db.init_hash
+        )
+        eng.on_demotion = store.accept
+        for i in range(5):
+            seq = eng.add_request(
+                _prompt(i, 16), SamplingParams(max_new_tokens=4)
+            )
+            eng.run_until_complete()
+            assert list(seq.generated_tokens) == want[i]
+        # Prompt 0's chain is long gone locally; bring it back.
+        hashes = eng.block_manager.token_db.prefix_hashes(_prompt(0, 16))
+        assert not any(eng.block_manager.is_block_resident(h) for h in hashes)
+        served = store.serve(hashes)
+        assert served
+        assert eng.import_kv_blocks(served) == len(served)
+        seq = eng.add_request(_prompt(0, 16), SamplingParams(max_new_tokens=4))
+        eng.run_until_complete()
+        assert seq.num_cached_prompt >= PS
+        assert list(seq.generated_tokens) == want[0]
+
+    def test_import_recycles_evictable_only_with_knob(self):
+        """allow_evict rides the remote_tier knob: the same full-pool
+        import installs under the knob (victims demote) and stops without
+        it (the PR 2 never-evict contract, unchanged)."""
+        chain = None
+        for remote in (True, False):
+            eng = _engine(total_pages=12, remote_tier=remote)
+            eng.on_demotion = lambda ps: None
+            # Fill the pool with evictable warmth, leaving no free pages.
+            for i in range(4):
+                eng.add_request(
+                    _prompt(i, 16), SamplingParams(max_new_tokens=4)
+                )
+                eng.run_until_complete()
+            free = len(eng.block_manager._free)
+            if chain is None:
+                donor = _engine(total_pages=64)
+                donor.add_request(
+                    _prompt(99, 16), SamplingParams(max_new_tokens=1)
+                )
+                donor.run_until_complete()
+                hashes = donor.block_manager.token_db.prefix_hashes(
+                    _prompt(99, 16)
+                )
+                chain = donor.export_kv_blocks(hashes)
+                assert chain
+            assert free < len(chain), "pool not saturated enough to test"
+            installed = eng.import_kv_blocks(list(chain))
+            if remote:
+                assert installed == len(chain)  # recycled evictable pages
+            else:
+                assert installed == free  # stopped at the free-page wall
+
+    def test_remote_store_serves_exports_and_digest(self):
+        events = []
+        eng = _engine(
+            total_pages=32,
+            remote_tier=True,
+            remote_store_pages=16,
+            on_events=events.append,
+        )
+        chain = _chain_payloads(
+            eng.block_manager.token_db.init_hash, n=3, seed=3
+        )
+        accepted, headroom = eng.accept_remote_blocks("peer", chain)
+        assert accepted == 3 and headroom == 13
+        assert eng.remote_headroom == 13
+        # The holder's own BlockStored(remote) events flushed immediately.
+        flat = [e for batch in events for e in batch]
+        stored = [e for e in flat if type(e).__name__ == "BlockStored"]
+        assert stored and all(e.medium == "remote" for e in stored)
+        # Digest grows the remote medium (resync keeps demoted entries).
+        digest = eng.block_digest()
+        assert set(digest["remote"]) == {b.block_hash for b in chain}
+        # The export path serves the store's blocks (pull-back read path).
+        hashes = [b.block_hash for b in chain]
+        out = eng.export_kv_blocks(hashes)
+        assert [b.block_hash for b in out] == hashes
+
+
+class TestPushOverZMQ:
+    def _wait(self, cond, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.05)
+        return False
+
+    def test_demote_push_pull_back_over_fabric(self):
+        from conftest import free_tcp_port
+
+        endpoint = f"tcp://127.0.0.1:{free_tcp_port()}"
+        holder = PodServer(
+            _pod_config(
+                "kv-holder",
+                transfer_endpoint=endpoint,
+                pod_role="kvstore",
+                remote_tier=True,
+                remote_store_pages=128,
+            )
+        )
+        demoter = PodServer(
+            _pod_config(
+                "demoter",
+                total_pages=12,
+                remote_tier=True,
+                remote_peers=endpoint,
+            )
+        )
+        holder.start()
+        demoter.start()
+        try:
+            outs = {}
+            for i in range(5):
+                seq = demoter.generate(
+                    _prompt(i, 16),
+                    SamplingParams(max_new_tokens=4),
+                    timeout=60,
+                )
+                outs[i] = list(seq.generated_tokens)
+            assert self._wait(
+                lambda: holder.engine.remote_store is not None
+                and len(holder.engine.remote_store) > 0
+            ), "demotions never reached the holder"
+            # kvstore pods never serve requests.
+            with pytest.raises(ValueError):
+                holder.submit(_prompt(0, 16))
+            # Pull the demoted chain back over the same fabric and serve
+            # prompt 0 warm with identical tokens.
+            hashes = demoter.engine.block_manager.token_db.prefix_hashes(
+                _prompt(0, 16)
+            )
+            self._wait(
+                lambda: any(
+                    h in holder.engine.remote_store for h in hashes[:1]
+                )
+            )
+            if any(h in holder.engine.remote_store for h in hashes[:1]):
+                n = demoter.pull_prefix(_prompt(0, 16), endpoint)
+                assert n >= 1
+            seq = demoter.generate(
+                _prompt(0, 16), SamplingParams(max_new_tokens=4), timeout=60
+            )
+            assert list(seq.generated_tokens) == outs[0]
+        finally:
+            demoter.shutdown()
+            holder.shutdown()
+
+    def test_tampered_push_rejected_over_wire(self):
+        from conftest import free_tcp_port
+
+        endpoint = f"tcp://127.0.0.1:{free_tcp_port()}"
+        holder = PodServer(
+            _pod_config(
+                "kv-holder2",
+                transfer_endpoint=endpoint,
+                remote_tier=True,
+                remote_store_pages=16,
+            )
+        )
+        holder.start()
+        client = KVTransferClient(
+            TransferClientConfig(endpoint=endpoint, timeout_s=5.0)
+        )
+        try:
+            init = holder.engine.block_manager.token_db.init_hash
+            good = _chain_payloads(init, n=1, seed=1)[0]
+            bad = _chain_payloads(init, n=1, seed=2)[0]
+            bad.token_ids = list(bad.token_ids)
+            bad.token_ids[0] ^= 1  # breaks the chain-hash check
+            trunc = _chain_payloads(init, n=1, seed=3)[0]
+            trunc.quant = "int8"
+            trunc.k_data = trunc.v_data = b"\x01" * int(np.prod(SHAPE))
+            trunc.k_scale = b"\x00" * (SCALE_BYTES - 4)
+            trunc.v_scale = b"\x00" * SCALE_BYTES
+            accepted, headroom = client.push_blocks(
+                MODEL, "attacker", [good, bad, trunc]
+            )
+            assert accepted == 1 and headroom == 15
+            store = holder.engine.remote_store
+            assert good.block_hash in store
+            assert bad.block_hash not in store
+            assert trunc.block_hash not in store
+            assert store.stats["rejected"] == 2
+        finally:
+            client.close()
+            holder.shutdown()
+
+    def test_push_to_legacy_service_refused(self):
+        from conftest import free_tcp_port
+
+        endpoint = f"tcp://127.0.0.1:{free_tcp_port()}"
+        pod = PodServer(_pod_config("plain", transfer_endpoint=endpoint))
+        pod.start()
+        client = KVTransferClient(
+            TransferClientConfig(endpoint=endpoint, timeout_s=5.0)
+        )
+        try:
+            init = pod.engine.block_manager.token_db.init_hash
+            with pytest.raises(TransferError, match="push unsupported"):
+                client.push_blocks(MODEL, "src", _chain_payloads(init, n=1))
+        finally:
+            client.close()
+            pod.shutdown()
+
+
+class TestDemotionTargets:
+    def test_zero_headroom_peer_ranks_last_but_stays_a_target(self):
+        """A full holder still accepts by LRU rotation; the first
+        headroom=0 ack must not turn demotion off for the process
+        lifetime."""
+        pod = PodServer(
+            _pod_config(
+                "ranker",
+                remote_tier=True,
+                remote_peers="tcp://a:1,tcp://b:2",
+            )
+        )
+        try:
+            with pod._mu:
+                pod._peer_headroom["tcp://a:1"] = 0  # acked full
+                pod._peer_headroom["tcp://b:2"] = 5
+            assert pod._demotion_targets() == ["tcp://b:2", "tcp://a:1"]
+            with pod._mu:
+                pod._peer_headroom["tcp://b:2"] = 0
+            # Every holder full: demotion still targets them (LRU
+            # rotation on the holder side), never silently stops.
+            assert pod._demotion_targets() == ["tcp://a:1", "tcp://b:2"]
+        finally:
+            pod.shutdown()
+
+    def test_full_store_accepts_by_rotation(self):
+        store = _store(capacity=2, init_hash=7)
+        store.accept(_chain_payloads(7, n=2))
+        assert store.headroom == 0
+        fresh = _chain_payloads(7, n=2, seed=5)
+        assert store.accept(fresh) == 2  # rotated, not refused
+        assert fresh[1].block_hash in store
+
+
+class TestDemotionChaos:
+    def test_partitioned_target_degrades_to_plain_eviction(self):
+        """A dead/unreachable demotion target must cost bounded timeouts,
+        never a stalled engine: generation completes, pages return to
+        baseline, the failures are counted."""
+        from conftest import free_tcp_port
+
+        dead = f"tcp://127.0.0.1:{free_tcp_port()}"  # nothing listens
+        pod = PodServer(
+            _pod_config(
+                "lonely",
+                total_pages=12,
+                remote_tier=True,
+                remote_peers=dead,
+                transfer_timeout_s=0.3,
+                transfer_breaker_failures=1,
+            )
+        )
+        pod.start()
+        baseline = pod.engine.block_manager.num_free
+        try:
+            t0 = time.monotonic()
+            for i in range(4):
+                seq = pod.generate(
+                    _prompt(i, 16),
+                    SamplingParams(max_new_tokens=4),
+                    timeout=60,
+                )
+                assert seq.num_generated == 4
+            assert time.monotonic() - t0 < 45
+            # Pusher drains its queue into failures (plain eviction).
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with pod._mu:
+                    if (
+                        not pod._demote_queue
+                        and pod.demote_failed_blocks > 0
+                    ):
+                        break
+                time.sleep(0.05)
+            assert pod.demote_failed_blocks > 0
+            assert pod.demote_pushed_blocks == 0
+            assert pod.engine.block_manager.num_free == baseline
+        finally:
+            pod.shutdown()
+
+
+class TestKnobsOffParity:
+    def test_defaults_off(self, monkeypatch):
+        for var in (
+            "REMOTE_TIER",
+            "REMOTE_STORE_PAGES",
+            "REMOTE_PEERS",
+            "REMOTE_DEMOTE_QUEUE",
+        ):
+            monkeypatch.delenv(var, raising=False)
+        cfg = PodServerConfig.from_env()
+        assert cfg.remote_tier is False
+        assert cfg.remote_store_pages == 0
+        assert cfg.remote_peers == ""
+        assert cfg.engine.remote_tier is False
+        assert EngineConfig.__dataclass_fields__["remote_tier"].default is False
+
+    def test_stats_payload_has_no_remote_block(self):
+        pod = PodServer(_pod_config("legacy"))
+        pod.start()
+        try:
+            import asyncio
+
+            from aiohttp.test_utils import TestClient, TestServer
+
+            async def go():
+                client = TestClient(TestServer(pod.build_app()))
+                await client.start_server()
+                try:
+                    resp = await client.get("/stats")
+                    return await resp.json()
+                finally:
+                    await client.close()
+
+            payload = asyncio.new_event_loop().run_until_complete(go())
+            assert "remote" not in payload
+            assert set(payload["transfer"].keys()) == {
+                "exported_blocks",
+                "imported_blocks",
+                "import_rejected",
+                "endpoint",
+                "pulls",
+                "pull_failures",
+                "breaker_skips",
+                "breakers",
+                "requests_served",
+            }
+        finally:
+            pod.shutdown()
+
+    def test_stats_remote_block_gated_on(self):
+        pod = PodServer(
+            _pod_config("rt", remote_tier=True, remote_store_pages=8)
+        )
+        pod.start()
+        try:
+            import asyncio
+
+            from aiohttp.test_utils import TestClient, TestServer
+
+            async def go():
+                client = TestClient(TestServer(pod.build_app()))
+                await client.start_server()
+                try:
+                    resp = await client.get("/stats")
+                    return await resp.json()
+                finally:
+                    await client.close()
+
+            payload = asyncio.new_event_loop().run_until_complete(go())
+            assert payload["remote"]["store_pages"] == 8
+            assert payload["remote"]["headroom"] == 8
+        finally:
+            pod.shutdown()
+
+    def test_remote_tier_entries_keyed_to_holder_in_index(self):
+        """End-to-end event-plane check: the HOLDER publishes the remote
+        BlockStored, so evicting the DEMOTER keeps the entry and evicting
+        the holder drops it (the death semantics the tier depends on)."""
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+            InMemoryIndexConfig,
+            Key,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvblock.in_memory import (
+            InMemoryIndex,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+            KVEventsPool,
+            KVEventsPoolConfig,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents.events import (
+            BlockStored,
+        )
+        from llm_d_kv_cache_manager_tpu.kvcache.kvevents.pool import Message
+
+        index = InMemoryIndex(InMemoryIndexConfig())
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1))
+        pool.start()
+        try:
+            batch = EventBatch(
+                ts=0.0,
+                events=[
+                    BlockStored(
+                        block_hashes=[11],
+                        token_ids=list(range(PS)),
+                        block_size=PS,
+                        medium="remote",
+                    )
+                ],
+            )
+            pool.add_task(
+                Message(
+                    topic="kv@kv-holder@m",
+                    pod_identifier="kv-holder",
+                    model_name="m",
+                    payload=batch.to_payload(),
+                    seq=0,
+                )
+            )
+            assert pool.drain(5)
+            key = Key("m", 11)
+            assert index.lookup([key], set())[key] == ["kv-holder"]
+            # The demoter dying is irrelevant to the holder's entry...
+            index.evict_pod("demoter")
+            assert index.lookup([key], set())[key] == ["kv-holder"]
+            # ...the holder dying drops it.
+            index.evict_pod("kv-holder")
+            assert index.lookup([key], set()).get(key, []) == []
+        finally:
+            pool.shutdown()
