@@ -1,6 +1,12 @@
 from . import kvblock  # noqa: F401
 from . import transfer  # noqa: F401
 from .indexer import KVCacheIndexer, KVCacheIndexerConfig
+from .predictor import (
+    PodSignals,
+    PredictionCorrector,
+    TTFTPredictor,
+    TTFTPredictorConfig,
+)
 from .router import (
     BlendedRouter,
     DisaggPlan,
@@ -36,6 +42,10 @@ __all__ = [
     "transfer",
     "KVCacheIndexer",
     "KVCacheIndexerConfig",
+    "PodSignals",
+    "PredictionCorrector",
+    "TTFTPredictor",
+    "TTFTPredictorConfig",
     "KVBlockScorer",
     "KVBlockScorerConfig",
     "LongestPrefixScorer",

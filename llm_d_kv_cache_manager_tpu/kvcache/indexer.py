@@ -141,6 +141,20 @@ class KVCacheIndexer:
             return {}
         return self._lookup_and_score(block_keys, pod_filter, placement)
 
+    def signal_views(
+        self, pods: Optional[Sequence[str]] = None
+    ) -> dict[str, dict]:
+        """Heartbeat-derived per-pod signal state (age / draining /
+        expired / role) for predicted-TTFT routing — the scorer-embedded
+        predictor merges these with the caller-supplied serving
+        telemetry (queue depth, prefill rate). ``pods`` scopes the
+        locked walk to the named pods (per-request callers). ``{}``
+        without an attached ``FleetHealth``: every signal then reads as
+        fresh, which is exactly the in-process single-binary case."""
+        if self.fleet_health is None:
+            return {}
+        return self.fleet_health.signal_views(pods)
+
     def _filter_expired(
         self, scores: dict[str, int], placement: Optional[str] = None
     ) -> dict[str, int]:

@@ -34,7 +34,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Sequence
 
 from ...utils import get_logger
 from ..kvblock import Index
@@ -292,6 +292,46 @@ class FleetHealth:
             if ttl <= 0:
                 return True
             return (self._clock() - st.last_seen) <= ttl
+
+    def signal_views(
+        self, pods: Optional[Sequence[str]] = None
+    ) -> dict[str, dict]:
+        """Predictor-facing snapshot in ONE locked cut: per-pod signal
+        age (the staleness gate's input — signals older than 2x the
+        heartbeat cadence decay to conservative defaults), draining/
+        expired state, and advertised role — the HTTP-deployment hook
+        for assembling ``predictor.PodSignals`` (queue depth and the
+        prefill-rate EMA ride the serving plane's own telemetry; this
+        carries the heartbeat-derived half). ``pods`` scopes the locked
+        walk to the named pods (the per-request path names a handful;
+        an O(fleet) cut per scoring request would scale lock-hold time
+        with fleet size); None walks everything (selection cadence).
+        Like ``pod_views``, a point-in-time read."""
+        ttl = self.config.pod_ttl_s
+        now = self._clock()
+        with self._mu:
+            items = (
+                [(p, self._pods[p]) for p in pods if p in self._pods]
+                if pods is not None
+                else list(self._pods.items())
+            )
+            return {
+                pod: {
+                    "age_s": (
+                        max(now - st.last_seen, 0.0)
+                        if st.last_seen > 0
+                        else None
+                    ),
+                    "draining": st.draining or st.drained,
+                    "expired": bool(
+                        st.swept
+                        or st.drained
+                        or (ttl > 0 and (now - st.last_seen) > ttl)
+                    ),
+                    "role": st.role,
+                }
+                for pod, st in items
+            }
 
     def role_of(self, pod: str) -> Optional[str]:
         """The pod's heartbeat-advertised role ("prefill"/"decode"/

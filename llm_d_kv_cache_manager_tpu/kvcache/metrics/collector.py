@@ -85,6 +85,12 @@ scoreboard_size = _NullMetric()
 route_pvr = _NullMetric()
 route_regret = _NullMetric()
 route_miss = _NullMetric()
+# Predicted-TTFT routing (ISSUE 14): the latency model's per-decision
+# prediction and its realized/predicted honesty ratio from the audit
+# join. Series appear only when ROUTE_PREDICT feeds them — a knobs-off
+# process never observes either.
+route_predicted_ttft = _NullMetric()
+route_ttft_ratio = _NullMetric()
 # Sharded control plane (PR 11): per-shard index occupancy and stale-ring
 # misroute forwards. Series appear only when SCORER_SHARDS partitions the
 # index — a knobs-off process never touches a shard label (the staleness /
@@ -130,6 +136,7 @@ def register(registry=None) -> None:
     global route_decisions, score_latency, index_blocks, index_pods
     global index_staleness, index_events_behind, scoreboard_size
     global route_pvr, route_regret, route_miss
+    global route_predicted_ttft, route_ttft_ratio
     global shard_blocks, shard_pods, shard_misroutes
     with _lock:
         if _registered:
@@ -284,6 +291,24 @@ def register(registry=None) -> None:
             ["cause"],
             registry=registry,
         )
+        route_predicted_ttft = _prom.Histogram(
+            "kvcache_route_predicted_ttft_seconds",
+            "Modeled TTFT of the chosen routing arm (queue wait + miss "
+            "prefill + pull cost, corrector-adjusted) per predicted-"
+            "routing decision (ROUTE_PREDICT)",
+            registry=registry,
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0, 60.0),
+        )
+        route_ttft_ratio = _prom.Histogram(
+            "kvcache_route_ttft_realized_over_predicted",
+            "Realized TTFT over the routing model's predicted TTFT per "
+            "audited request (1.0 = the latency model told the truth; "
+            "ROUTE_PREDICT + OBS_AUDIT join)",
+            registry=registry,
+            buckets=(0.1, 0.25, 0.5, 0.8, 0.9, 1.0, 1.1, 1.25, 1.5, 2.0,
+                     4.0, 10.0),
+        )
         shard_blocks = _prom.Gauge(
             "kvcache_index_shard_blocks",
             "Block keys tracked by one scorer shard's sub-index "
@@ -352,6 +377,19 @@ def observe_predicted_vs_realized(ratio: float) -> None:
 
 def observe_route_regret(decision: str, regret_blocks: int) -> None:
     route_regret.labels(decision=decision).observe(regret_blocks)
+
+
+def observe_predicted_ttft(seconds: float) -> None:
+    """One predicted-routing decision's modeled TTFT (ROUTE_PREDICT)."""
+    bump("route_predictions")
+    route_predicted_ttft.observe(seconds)
+
+
+def observe_ttft_ratio(ratio: float) -> None:
+    """Realized/predicted TTFT for one audited predicted-routing
+    decision (ROUTE_PREDICT + OBS_AUDIT join)."""
+    bump("route_ttft_joins")
+    route_ttft_ratio.observe(ratio)
 
 
 def observe_miss_cause(cause: str) -> None:

@@ -42,6 +42,7 @@ from typing import Callable, Optional, Sequence
 
 from .kvblock.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
 from .metrics import collector
+from .predictor import PodSignals
 
 
 class PrefixAffinityTracker:
@@ -106,6 +107,9 @@ class RoutingDecision:
     pull_source: Optional[str] = None
     #: consecutive warm prefix blocks available at ``pull_source``
     pull_blocks: int = 0
+    #: modeled TTFT of the chosen arm (ROUTE_PREDICT only; None = the
+    #: legacy score-max ranking made this decision)
+    predicted_ttft_s: Optional[float] = None
 
 
 class BlendedRouter:
@@ -134,6 +138,8 @@ class BlendedRouter:
         auditor=None,
         remote_score_fn: Optional[Callable] = None,
         remote_endpoint_of: Optional[Callable[[str], Optional[str]]] = None,
+        predictor=None,
+        signals_fn: Optional[Callable] = None,
     ):
         """``auditor`` (optional, an ``obs.RouteAuditor``): records each
         decision's predicted matched-block count + scoreboard keyed by
@@ -153,7 +159,20 @@ class BlendedRouter:
         hit. ``remote_endpoint_of(holder) -> transfer endpoint`` maps the
         holder's pod identity to its export endpoint (None keeps the pod
         name, which in-process fleets use directly). Both None (default)
-        = bit-identical legacy routing."""
+        = bit-identical legacy routing.
+
+        ``predictor`` (optional, a ``kvcache.predictor.TTFTPredictor``
+        — the ``ROUTE_PREDICT`` knob): replace score-max ranking with
+        predicted-TTFT minimization — per candidate pod, queue wait
+        (depth x measured prefill rate) + miss-suffix prefill time
+        (+ measured pull cost for pull arms), argmin wins.
+        ``signals_fn(pods) -> [PodSignals]`` supplies the per-pod queue
+        depth / prefill rate / liveness signals (heartbeat state or live
+        attribute reads); without it the predictor only sees loads and
+        abstains. The predictor ABSTAINS (None) until a prefill rate is
+        measured, and whenever every candidate predicts inf — in both
+        cases this router's decision is bit-identical to the legacy
+        path. None (default) = legacy score-max routing."""
         self.score_fn = score_fn
         self.affinity = affinity
         self.loads_fn = loads_fn
@@ -161,6 +180,8 @@ class BlendedRouter:
         self.auditor = auditor
         self.remote_score_fn = remote_score_fn
         self.remote_endpoint_of = remote_endpoint_of
+        self.predictor = predictor
+        self.signals_fn = signals_fn
 
     def route(
         self,
@@ -176,6 +197,23 @@ class BlendedRouter:
         aff_scores = [
             self.affinity.score(keys, i, now) for i in range(len(pods))
         ]
+        predicted = (
+            self._predict(tokens, pods, scores, loads, aff_scores)
+            if self.predictor is not None
+            else None
+        )
+        if predicted is not None:
+            # Predicted-TTFT minimization (ROUTE_PREDICT): the argmin of
+            # the modeled latency replaces score-max ranking entirely —
+            # the legacy block below never runs for this decision.
+            target, action, pull_source, pull_blocks, predicted_ttft = predicted
+            warm_blocks = scores.get(pods[target], 0)
+            collector.observe_predicted_ttft(predicted_ttft)
+            return self._finish(
+                tokens, pods, scores, keys, loads, aff_scores, now,
+                target, action, pull_source, pull_blocks, warm_blocks,
+                request_id, trace_id, predicted_ttft,
+            )
         best = max(
             range(len(pods)),
             key=lambda i: (scores.get(pods[i], 0), aff_scores[i], -loads[i], -i),
@@ -232,6 +270,83 @@ class BlendedRouter:
                             if self.remote_endpoint_of is not None
                             else holder
                         ) or holder
+        return self._finish(
+            tokens, pods, scores, keys, loads, aff_scores, now,
+            target, action, pull_source, pull_blocks, warm_blocks,
+            request_id, trace_id, None,
+        )
+
+    def _predict(self, tokens, pods, scores, loads, aff_scores):
+        """ROUTE_PREDICT arm: ask the predictor for every pod's best
+        modeled arm and argmin. Returns ``(target_idx, action,
+        pull_source, pull_blocks, predicted_ttft_s)`` or None when the
+        model abstains (no measured rate / every arm inf) — the legacy
+        ranking then stands, so prediction can never make a decision the
+        legacy fleet could not survive."""
+        signals = list(self.signals_fn(pods)) if self.signals_fn else []
+        by_name = {s.name: s for s in signals}
+        sigs = [
+            by_name.get(p, PodSignals(name=p, queue_depth=loads[i]))
+            for i, p in enumerate(pods)
+        ]
+        cm = self.cost_model
+        # The remote scan is only worth paying when a cost model exists
+        # to price the resulting pull arms (same gate as the legacy
+        # remote block) — without one every pull arm is inf anyway.
+        remote = (
+            self.remote_score_fn(tokens)
+            if self.remote_score_fn is not None and cm is not None
+            else None
+        )
+        arms = self.predictor.predict_routes(
+            sigs,
+            len(tokens),
+            scores,
+            remote_scores=remote,
+            remote_endpoint_of=self.remote_endpoint_of,
+            transfer_rate=cm.transfer_rate if cm is not None else None,
+            block_bytes=cm.config.block_bytes if cm is not None else 0,
+            max_pull_blocks=(
+                cm.config.max_pull_blocks if cm is not None else None
+            ),
+        )
+        if not arms:
+            return None
+        candidates = [
+            (i, arms[p]) for i, p in enumerate(pods)
+            if p in arms and arms[p].ttft_s != float("inf")
+        ]
+        if not candidates:
+            self.predictor.note_abstained()
+            return None
+        # Argmin with a tie band: candidates whose modeled TTFT is
+        # within tie_band (relative) + tie_abs_s of the best are TIES —
+        # the model sees no meaningful latency difference there, and
+        # scattering a warm prefix group over sub-noise deltas would
+        # trade real future hits for nothing. Ties resolve by the legacy
+        # ranking axes (warmth, affinity, load, index), so quiet traffic
+        # routes exactly as the score-max fleet would.
+        cfg = self.predictor.config
+        best_ttft = min(c[1].ttft_s for c in candidates)
+        threshold = best_ttft * (1.0 + cfg.tie_band) + cfg.tie_abs_s
+        ties = [c for c in candidates if c[1].ttft_s <= threshold]
+        i, arm = max(
+            ties,
+            key=lambda c: (
+                scores.get(pods[c[0]], 0),
+                aff_scores[c[0]],
+                -loads[c[0]],
+                -c[1].ttft_s,
+                -c[0],
+            ),
+        )
+        return i, arm.action, arm.pull_source, arm.pull_blocks, arm.ttft_s
+
+    def _finish(
+        self, tokens, pods, scores, keys, loads, aff_scores, now,
+        target, action, pull_source, pull_blocks, warm_blocks,
+        request_id, trace_id, predicted_ttft,
+    ):
         self.affinity.record(keys, target, now)
         # Routing-quality observability: verdict counts let dashboards see
         # the warm/pull/cold mix shift as the fleet warms or thrashes
@@ -255,15 +370,15 @@ class BlendedRouter:
             # (dead peer, cold fallback) with nothing to attribute.
             index_blocks = scores.get(pods[target], 0)
             if action == "pull":
-                predicted = pull_blocks
+                predicted_blocks = pull_blocks
             elif index_blocks > 0:
-                predicted = index_blocks
+                predicted_blocks = index_blocks
             else:
-                predicted = aff_scores[target]
+                predicted_blocks = aff_scores[target]
             self.auditor.record_decision(
                 request_id,
                 chosen_pod=pods[target],
-                predicted_blocks=predicted,
+                predicted_blocks=predicted_blocks,
                 index_blocks=index_blocks,
                 scoreboard=scores,
                 decision=(
@@ -273,6 +388,7 @@ class BlendedRouter:
                 ),
                 chain_hashes=keys,
                 trace_id=trace_id,
+                predicted_ttft_s=predicted_ttft,
             )
         # Decision metadata is DECISION-time state (what drove the pick),
         # captured before record() refreshes the affinity memory.
@@ -283,6 +399,7 @@ class BlendedRouter:
             action=action,
             pull_source=pull_source,
             pull_blocks=pull_blocks,
+            predicted_ttft_s=predicted_ttft,
         )
 
 

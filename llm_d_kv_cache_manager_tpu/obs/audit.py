@@ -34,6 +34,14 @@ behavior when unattached:
     ground truth disagrees: the pod evicted them locally and the index
     has not caught up (phantom locality, repaired by events/resync).
 
+Since ISSUE 14 the join also carries the predicted-TTFT loop: decisions
+made by the ROUTE_PREDICT latency model record their modeled TTFT, joins
+from in-process callers carry the realized TTFT, and the resulting
+realized/predicted ratio is observed
+(``kvcache_route_ttft_realized_over_predicted``) and fed to the model's
+``PredictionCorrector`` — the audit plane acting as an actuator, not
+just a dashboard.
+
 Wall clock on purpose throughout: event publish timestamps cross the wire
 and are compared across hosts, so the comparison clock must be the same
 wall clock (injectable for tests and the bench's virtual clocks).
@@ -301,6 +309,14 @@ class AuditRecord:
     #: wall-clock timestamps (decision / join) — display only
     decided_at: float = 0.0
     joined_at: float = 0.0
+    #: predicted-TTFT routing (ROUTE_PREDICT): the latency model's
+    #: per-decision claim, the realized TTFT the pod measured, and their
+    #: realized/predicted ratio — None on legacy (score-max) decisions,
+    #: and the row keys are then absent so knobs-off /debug/audit rows
+    #: stay bit-identical
+    predicted_ttft_s: Optional[float] = None
+    realized_ttft_s: Optional[float] = None
+    ttft_ratio: Optional[float] = None
 
     def to_dict(self) -> dict:
         return {
@@ -316,6 +332,15 @@ class AuditRecord:
             "trace_id": self.trace_id,
             "decided_at": self.decided_at,
             "joined_at": self.joined_at,
+            **(
+                {
+                    "predicted_ttft_s": self.predicted_ttft_s,
+                    "realized_ttft_s": self.realized_ttft_s,
+                    "ttft_ratio": self.ttft_ratio,
+                }
+                if self.predicted_ttft_s is not None
+                else {}
+            ),
         }
 
 
@@ -333,6 +358,8 @@ class _Pending:
     model: str
     trace_id: Optional[str]
     decided_at: float
+    #: the latency model's TTFT claim (ROUTE_PREDICT); None = legacy
+    predicted_ttft_s: Optional[float] = None
 
 
 class RouteAuditor:
@@ -354,10 +381,20 @@ class RouteAuditor:
         pending_cap: int = 4096,
         max_chain_hashes: int = 512,
         clock: Callable[[], float] = time.time,
+        ttft_corrector=None,
     ):
+        """``ttft_corrector`` (optional, a
+        ``kvcache.predictor.PredictionCorrector`` — wired by
+        ``ROUTE_PREDICT``): joins that carry BOTH a predicted and a
+        realized TTFT feed it the outcome, closing the routing model's
+        feedback loop — the audit plane acting as an actuator. The feed
+        is skipped when the request landed on a different pod than the
+        one predicted for (the outcome is not that pod's model error).
+        None (default) = observation-only, legacy behavior."""
         self.index = index
         self.fleet_health = fleet_health
         self.model_name = model_name
+        self.ttft_corrector = ttft_corrector
         self.max_chain_hashes = max_chain_hashes
         self._clock = clock
         self._mu = threading.Lock()
@@ -383,6 +420,7 @@ class RouteAuditor:
         chain_hashes: Sequence[int] = (),
         model: Optional[str] = None,
         trace_id: Optional[str] = None,
+        predicted_ttft_s: Optional[float] = None,
     ) -> None:
         """Record what the scorer promised for ``request_id``. ``scoreboard``
         is the top-k pod→score map the decision saw; regret = the best
@@ -406,6 +444,7 @@ class RouteAuditor:
             model=model if model is not None else self.model_name,
             trace_id=trace_id,
             decided_at=self._clock(),
+            predicted_ttft_s=predicted_ttft_s,
         )
         with self._mu:
             self._pending[request_id] = rec
@@ -418,11 +457,20 @@ class RouteAuditor:
 
     # -- realized side (pod report via RequestAudit event or in-process) ----
     def record_realized(
-        self, request_id: str, pod: str, realized_blocks: int
+        self,
+        request_id: str,
+        pod: str,
+        realized_blocks: int,
+        realized_ttft_s: Optional[float] = None,
     ) -> Optional[AuditRecord]:
         """Join the pod's ground truth with the pending decision. Returns
         the joined record (also ring-buffered for ``/debug/audit``), or
-        None when no decision was recorded for this request id."""
+        None when no decision was recorded for this request id.
+        ``realized_ttft_s`` (in-process callers only — the RequestAudit
+        wire event carries blocks, not latency) additionally joins the
+        predicted-TTFT claim: the realized/predicted latency ratio is
+        observed and, when a corrector is attached, fed back to the
+        routing model."""
         with self._mu:
             rec = self._pending.pop(request_id, None)
             if rec is None:
@@ -437,6 +485,24 @@ class RouteAuditor:
             collector.observe_miss_cause(cause)
         if ratio is not None:
             collector.observe_predicted_vs_realized(ratio)
+        ttft_ratio = None
+        if (
+            rec.predicted_ttft_s is not None
+            and rec.predicted_ttft_s > 0
+            and realized_ttft_s is not None
+            and pod == rec.chosen_pod
+        ):
+            # Only the pod the model predicted FOR can judge the model:
+            # a rerouted request's latency has another pod's denominator
+            # and would pollute the honesty histogram exactly when the
+            # prediction was never followed. (The row still records
+            # realized_ttft_s for the reroute, just no ratio.)
+            ttft_ratio = realized_ttft_s / rec.predicted_ttft_s
+            collector.observe_ttft_ratio(ttft_ratio)
+            if self.ttft_corrector is not None:
+                self.ttft_corrector.observe(
+                    pod, rec.predicted_ttft_s, realized_ttft_s
+                )
         audit = AuditRecord(
             request_id=request_id,
             chosen_pod=rec.chosen_pod,
@@ -450,6 +516,11 @@ class RouteAuditor:
             trace_id=rec.trace_id,
             decided_at=rec.decided_at,
             joined_at=self._clock(),
+            predicted_ttft_s=rec.predicted_ttft_s,
+            realized_ttft_s=realized_ttft_s,
+            ttft_ratio=(
+                round(ttft_ratio, 4) if ttft_ratio is not None else None
+            ),
         )
         with self._mu:
             self.joined += 1
@@ -518,6 +589,9 @@ class RouteAuditor:
     def snapshot(self) -> dict:
         with self._mu:
             ratios = [r.ratio for r in self._ring if r.ratio is not None]
+            ttft_ratios = [
+                r.ttft_ratio for r in self._ring if r.ttft_ratio is not None
+            ]
             return {
                 "decisions_recorded": self.decisions_recorded,
                 "joined": self.joined,
@@ -526,6 +600,13 @@ class RouteAuditor:
                 "unmatched_realized": self.unmatched_realized,
                 "miss_causes": dict(self.miss_causes),
                 "recent_ratio_p50": _percentile(ratios, 0.5),
+                # Key appears only once a predicted-TTFT join happened:
+                # knobs-off audit snapshots keep their legacy field set.
+                **(
+                    {"ttft_ratio_p50": _percentile(ttft_ratios, 0.5)}
+                    if ttft_ratios
+                    else {}
+                ),
             }
 
 

@@ -117,6 +117,24 @@ class ServiceConfig:
     #: virtual nodes per shard on the consistent-hash ring (sizing: higher
     #: = smoother balance and smaller resize movement, more ring memory)
     scorer_shard_vnodes: int = 64
+    #: predicted-TTFT routing (ISSUE 14): attach a ``TTFTPredictor`` to
+    #: the scoring plane — scoring requests carrying a ``signals`` body
+    #: field (per-pod queue depth / prefill rate from the caller's
+    #: serving telemetry) get a ``predicted_ttft_s`` map alongside the
+    #: scores, so an EPP-style router can argmin on modeled latency.
+    #: The corrector loop needs a realized-TTFT feed, which only
+    #: IN-PROCESS callers have (``RouteAuditor.record_realized(...,
+    #: realized_ttft_s=)``; the ``RequestAudit`` wire event carries
+    #: blocks, not latency — no new wire fields): an HTTP-only
+    #: deployment serves uncorrected model output and its /stats
+    #: ``predict.corrector`` stays at bias 1.0. Off (default) = no new
+    #: body fields read, bit-identical responses and ``/stats``.
+    route_predict: bool = False
+    #: the fleet's heartbeat cadence for the predictor's staleness gate:
+    #: a pod whose last heartbeat is older than 2x this treats its
+    #: queue/rate signals as unknown (conservative defaults). 0 = the
+    #: staleness gate is off (signals trusted as supplied)
+    route_predict_heartbeat_s: float = 0.0
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -143,6 +161,11 @@ class ServiceConfig:
             in ("1", "true", "yes", "on"),
             scorer_shards=int(env.get("SCORER_SHARDS", "0")),
             scorer_shard_vnodes=int(env.get("SCORER_SHARD_VNODES", "64")),
+            route_predict=env.get("ROUTE_PREDICT", "").strip().lower()
+            in ("1", "true", "yes", "on"),
+            route_predict_heartbeat_s=float(
+                env.get("ROUTE_PREDICT_HEARTBEAT_S", "0")
+            ),
         )
 
 
@@ -270,11 +293,32 @@ class ScoringService:
                 self.staleness = StalenessTracker()
         else:
             self.staleness = None
+        #: predicted-TTFT routing (ROUTE_PREDICT): the latency model +
+        #: per-pod corrector. None (default) = no predictor, no new body
+        #: fields read, bit-identical responses and /stats.
+        if cfg.route_predict:
+            from ..kvcache.predictor import TTFTPredictor, TTFTPredictorConfig
+
+            self.predictor = TTFTPredictor(
+                TTFTPredictorConfig(
+                    block_size=cfg.block_size,
+                    heartbeat_interval_s=cfg.route_predict_heartbeat_s,
+                )
+            )
+        else:
+            self.predictor = None
         self.route_auditor = (
             RouteAuditor(
                 index=self.indexer.kv_block_index,
                 fleet_health=self.fleet_health,
                 ring=cfg.obs_audit_ring,
+                # The audit plane as an actuator: joins carrying realized
+                # TTFT correct the routing model's per-pod bias.
+                ttft_corrector=(
+                    self.predictor.corrector
+                    if self.predictor is not None
+                    else None
+                ),
             )
             if cfg.obs_audit
             else None
@@ -358,15 +402,28 @@ class ScoringService:
         placement, bad = _parse_placement(body)
         if bad is not None:
             return bad
-        headers, scores, degraded = await self._traced_score(
+        headers, scores, degraded, predicted = await self._traced_score(
             request, "/score_completions", prompt, model, pods, placement,
             request_id=self._audit_request_id(body),
+            signals=self._parse_signals(body, placement, pods),
         )
         if degraded is not None:
             return web.json_response(
                 {"scores": {}, "degraded": degraded}, headers=headers
             )
-        return web.json_response({"scores": scores}, headers=headers)
+        return web.json_response(
+            {
+                "scores": scores,
+                # Key appears only under ROUTE_PREDICT with signals
+                # supplied: knobs-off responses keep their legacy keys.
+                **(
+                    {"predicted_ttft_s": predicted}
+                    if predicted is not None
+                    else {}
+                ),
+            },
+            headers=headers,
+        )
 
     def _audit_request_id(self, body: dict) -> Optional[str]:
         """The optional ``request_id`` scoring-body field, read ONLY with
@@ -377,6 +434,77 @@ class ScoringService:
         rid = body.get("request_id")
         return rid if isinstance(rid, str) and rid else None
 
+    def _parse_signals(self, body: dict, placement=None, candidates=None):
+        """The optional ``signals`` scoring-body field (ROUTE_PREDICT):
+        ``[{"pod": str, "queue_depth": num?, "prefill_rate": num?}, ...]``
+        — the caller's serving-plane telemetry, merged with the
+        heartbeat-derived half (signal age, draining/expired, role) from
+        fleet health. Read ONLY with the predict knob on; malformed rows
+        are skipped (a bad signal must not fail scoring). Rows naming
+        pods outside ``candidates`` (the request's ``pod_identifiers``,
+        when given) or whose advertised role cannot serve ``placement``
+        are dropped — ``predicted_ttft_s`` must never steer the caller
+        toward a pod the scoreboard's own filters would have rejected."""
+        if self.predictor is None:
+            return None
+        raw = body.get("signals")
+        if not isinstance(raw, list) or not raw:
+            return None
+        from ..kvcache.predictor import PodSignals
+
+        allowed = set(candidates) if candidates else None
+        # Same role gate as FleetHealth.filter_scores (kvstore is
+        # excluded by the predictor itself; "pull_source" has no gate).
+        wrong_role = {
+            "prefill": {"decode"},
+            "decode": {"prefill"},
+        }.get(placement, set())
+        # Scope the fleet-health cut to the pods this request names —
+        # an O(fleet) locked walk per scoring request would scale with
+        # fleet size, not request size.
+        named = [
+            row["pod"]
+            for row in raw
+            if isinstance(row, dict) and isinstance(row.get("pod"), str)
+        ]
+        views = self.indexer.signal_views(named)
+        sigs = []
+        for row in raw:
+            if not isinstance(row, dict) or not isinstance(
+                row.get("pod"), str
+            ):
+                continue
+            if allowed is not None and row["pod"] not in allowed:
+                continue
+            view = views.get(row["pod"], {})
+            if view.get("role") in wrong_role:
+                continue
+            qd = row.get("queue_depth")
+            pr = row.get("prefill_rate")
+            sigs.append(
+                PodSignals(
+                    name=row["pod"],
+                    queue_depth=(
+                        float(qd)
+                        if isinstance(qd, (int, float))
+                        and not isinstance(qd, bool)
+                        else None
+                    ),
+                    prefill_rate=(
+                        float(pr)
+                        if isinstance(pr, (int, float))
+                        and not isinstance(pr, bool)
+                        and pr > 0
+                        else None
+                    ),
+                    draining=bool(view.get("draining", False)),
+                    dead=bool(view.get("expired", False)),
+                    role=view.get("role"),
+                    signal_age_s=view.get("age_s"),
+                )
+            )
+        return sigs or None
+
     async def _traced_score(
         self,
         request: web.Request,
@@ -386,6 +514,7 @@ class ScoringService:
         pods,
         placement=None,
         request_id: Optional[str] = None,
+        signals=None,
     ):
         """The one scoring path both endpoints share: trace mint-or-adopt
         (the scoring service is the fleet's front door, so the trace id
@@ -398,7 +527,13 @@ class ScoringService:
         without cache affinity (a 500 here would turn an index outage
         into a serving outage). ``placement`` ("prefill"/"decode"/None)
         is the disagg tier being placed for — pods whose advertised role
-        cannot serve it are dropped from the scoreboard."""
+        cannot serve it are dropped from the scoreboard.
+
+        ``signals`` (ROUTE_PREDICT, parsed ``PodSignals``): the modeled
+        per-pod TTFT rides back as the fourth tuple element so an
+        external router can argmin on latency instead of score-max —
+        None everywhere else, and the response then carries no new key.
+        Returns ``(headers, scores, degraded, predicted_ttft)``."""
         loop = asyncio.get_running_loop()
         span = self.tracer.start_span(
             "scorer.score",
@@ -417,33 +552,83 @@ class ScoringService:
         ):
             t0 = time.perf_counter()
             try:
-                scores = await loop.run_in_executor(
-                    None, self.indexer.get_pod_scores, prompt, model, pods,
-                    placement,
-                )
+                if signals is not None and self.predictor is not None:
+                    # The predict path tokenizes once and scores the
+                    # token ids directly (the pool's prefix store makes
+                    # the split free), because the latency model needs
+                    # the prompt's token length for its miss term.
+                    def score_with_len():
+                        toks = self.indexer.tokenization_pool.tokenize(
+                            prompt, model
+                        )
+                        return (
+                            self.indexer.score_tokens(
+                                toks, model, pods, placement
+                            ),
+                            len(toks),
+                        )
+
+                    scores, prompt_len = await loop.run_in_executor(
+                        None, score_with_len
+                    )
+                else:
+                    scores = await loop.run_in_executor(
+                        None, self.indexer.get_pod_scores, prompt, model,
+                        pods, placement,
+                    )
+                    prompt_len = None
             except Exception as exc:
                 log.exception("scoring failed; degrading to empty scoreboard")
                 collector.bump("scorer_errors")
                 collector.scorer_errors.inc()
                 span.set_attr("error", type(exc).__name__)
-                return headers, None, str(exc)
+                return headers, None, str(exc), None
             collector.score_latency.observe(time.perf_counter() - t0)
             span.set_attr("pods_scored", len(scores))
             if self.config.obs_metrics:
                 collector.set_scoreboard_size(len(scores))
                 self._last_scoreboard_size = len(scores)
+            predicted = None
+            if (
+                signals is not None
+                and self.predictor is not None
+                and prompt_len
+            ):
+                arms = self.predictor.predict_routes(
+                    signals, prompt_len, scores
+                )
+                if arms:
+                    predicted = {
+                        p: round(a.ttft_s, 6)
+                        for p, a in arms.items()
+                        if a.ttft_s != float("inf")
+                    }
+                    if predicted:
+                        collector.observe_predicted_ttft(
+                            min(predicted.values())
+                        )
             if self.route_auditor is not None and request_id is not None:
                 # The scorer's half of the audit: the scoreboard this
                 # request saw, with the argmax pod standing in for the
                 # caller's eventual pick (the HTTP deployment's router is
                 # external; an in-process BlendedRouter records richer
-                # decisions itself). Empty scoreboard = an honest cold
-                # prediction of 0 blocks.
-                chosen = (
-                    max(scores, key=lambda p: (scores[p], p))
-                    if scores
-                    else ""
-                )
+                # decisions itself) — under ROUTE_PREDICT the stand-in
+                # is the latency argmin, the pod the caller will pick.
+                # Empty scoreboard = an honest cold prediction of 0
+                # blocks.
+                if predicted:
+                    chosen = min(
+                        predicted, key=lambda p: (predicted[p], p)
+                    )
+                else:
+                    chosen = (
+                        max(scores, key=lambda p: (scores[p], p))
+                        if scores
+                        else ""
+                    )
+                predicted_ttft_chosen = None
+                if predicted and chosen in predicted:
+                    predicted_ttft_chosen = predicted[chosen]
                 self.route_auditor.record_decision(
                     request_id,
                     chosen_pod=chosen,
@@ -456,8 +641,9 @@ class ScoringService:
                         if span.context is not None
                         else None
                     ),
+                    predicted_ttft_s=predicted_ttft_chosen,
                 )
-        return headers, scores, None
+        return headers, scores, None, predicted
 
     async def handle_score_chat_completions(self, request: web.Request) -> web.Response:
         try:
@@ -508,10 +694,13 @@ class ScoringService:
         except Exception as exc:
             log.exception("chat template render failed")
             return web.json_response({"error": str(exc)}, status=400)
-        headers, scores, degraded = await self._traced_score(
+        headers, scores, degraded, predicted = await self._traced_score(
             request, "/score_chat_completions", prompt, model,
             body.get("pod_identifiers") or [], placement,
             request_id=self._audit_request_id(body),
+            signals=self._parse_signals(
+                body, placement, body.get("pod_identifiers") or []
+            ),
         )
         if degraded is not None:
             # Index backend down: same degradation contract as
@@ -520,7 +709,15 @@ class ScoringService:
                 {"scores": {}, "degraded": degraded}, headers=headers
             )
         return web.json_response(
-            {"scores": scores, "rendered_prompt_chars": len(prompt)},
+            {
+                "scores": scores,
+                "rendered_prompt_chars": len(prompt),
+                **(
+                    {"predicted_ttft_s": predicted}
+                    if predicted is not None
+                    else {}
+                ),
+            },
             headers=headers,
         )
 
@@ -622,6 +819,11 @@ class ScoringService:
             payload["staleness"] = self.staleness.snapshot()
         if self.route_auditor is not None:
             payload["audit"] = self.route_auditor.snapshot()
+        if self.predictor is not None:
+            # Gated on ROUTE_PREDICT: the latency model's honesty
+            # surface — prediction/abstention counts and the per-pod
+            # corrector biases the audit joins have learned.
+            payload["predict"] = self.predictor.snapshot()
         if self.sharded_index is not None:
             # Gated on SCORER_SHARDS: the knobs-off /stats payload keeps
             # its legacy field set bit-identical. The per-shard occupancy

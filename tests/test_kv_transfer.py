@@ -628,3 +628,110 @@ class TestFleetColdJoin:
             warm.shutdown(), cold.shutdown()
             pool.shutdown()
             indexer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# TransferClientPool edge coverage (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTransferClientPoolEdges:
+    """The per-endpoint client pool's sharp edges: breaker knowledge must
+    survive pooling (an OPEN breaker is precisely the state worth
+    keeping), teardown must not corrupt the accounting, and a concurrent
+    first dial must produce exactly one client per endpoint."""
+
+    def _pool(self, **cfg_kw):
+        from llm_d_kv_cache_manager_tpu.kvcache.transfer import (
+            TransferClientConfig,
+            TransferClientPool,
+        )
+
+        cfg_kw.setdefault("timeout_s", 0.05)
+        return TransferClientPool(
+            lambda ep: TransferClientConfig(endpoint=ep, **cfg_kw)
+        )
+
+    def test_open_breaker_client_is_retained_not_redialed(self):
+        from conftest import free_tcp_port
+
+        pool = self._pool(breaker_failures=1, breaker_backoff_s=60.0)
+        endpoint = f"tcp://127.0.0.1:{free_tcp_port()}"  # nothing listens
+        client = pool.get(endpoint)
+        with pytest.raises(TransferError):
+            client.fetch("m", [1, 2])
+        assert client.breaker.snapshot()["state"] == "open"
+        dials_before = client.dials
+        # The pool hands back the SAME client: replacing it would throw
+        # away the breaker state and pay a fresh timeout the breaker
+        # exists to skip.
+        again = pool.get(endpoint)
+        assert again is client
+        with pytest.raises(TransferError):
+            again.fetch("m", [1, 2])
+        assert again.breaker_skips == 1  # instant skip: no socket I/O
+        assert again.dials == dials_before  # and no re-dial
+        pool.close_all()
+
+    def test_closed_client_replaced_with_fresh_counters(self):
+        from conftest import free_tcp_port
+
+        pool = self._pool()
+        endpoint = f"tcp://127.0.0.1:{free_tcp_port()}"
+        c1 = pool.get(endpoint)
+        with pytest.raises(TransferError):
+            c1.fetch("m", [1])  # dial once so the counters move
+        assert c1.dials == 1
+        c1.close()
+        assert c1.closed
+        c2 = pool.get(endpoint)
+        assert c2 is not c1
+        assert (c2.dials, c2.reuses) == (0, 0)
+        snap = pool.snapshot()
+        assert snap[endpoint]["dials"] == 0 and snap[endpoint]["reuses"] == 0
+        pool.close_all()
+
+    def test_counters_consistent_across_teardown(self):
+        from conftest import free_tcp_port
+
+        pool = self._pool()
+        eps = [f"tcp://127.0.0.1:{free_tcp_port()}" for _ in range(2)]
+        clients = [pool.get(ep) for ep in eps]
+        for c in clients:
+            with pytest.raises(TransferError):
+                c.fetch("m", [1])
+        before = pool.snapshot()
+        assert all(before[ep]["dials"] == 1 for ep in eps)
+        pool.close_all()
+        # Teardown closes every client exactly once and empties the
+        # pool; a get() after close must not resurrect a socket.
+        assert all(c.closed for c in clients)
+        assert pool.snapshot() == {}
+        assert pool.get(eps[0]) is None
+        # The closed clients' own counters survive for post-mortem
+        # reads (no reset-on-close surprises).
+        assert clients[0].dials == 1
+
+    def test_concurrent_first_dial_produces_one_client(self):
+        from conftest import free_tcp_port
+
+        pool = self._pool()
+        endpoint = f"tcp://127.0.0.1:{free_tcp_port()}"
+        results = []
+        mu = threading.Lock()
+        barrier = threading.Barrier(8)
+
+        def grab():
+            barrier.wait()
+            c = pool.get(endpoint)
+            with mu:
+                results.append(c)
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 8
+        assert len({id(c) for c in results}) == 1
+        pool.close_all()

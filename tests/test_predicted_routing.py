@@ -1,0 +1,793 @@
+"""Predicted-TTFT routing suite (ISSUE 14 acceptance).
+
+The router's third generation: score-max (seed) → blended warmth/
+affinity/load (round 4) → predicted-TTFT minimization (this round).
+Coverage:
+
+- **Predictor math**: the queue / miss-prefill / pull terms of the
+  latency model, the prompt-work EMA, and the eligibility gates.
+- **Corrector convergence**: an injected rate lie (heartbeats claiming a
+  pod is fast when it is not) is corrected by the per-pod EWMA within a
+  few audit joins, and the audit plane actually feeds it
+  (``RouteAuditor(ttft_corrector=...)`` — the actuator loop).
+- **Stale-heartbeat degradation** (satellite): a pod whose signals are
+  older than 2x the heartbeat cadence decays to conservative defaults —
+  a frozen shallow queue never attracts the fleet.
+- **Never-pick gates**: draining / dead / kvstore / admission-closed
+  pods predict ``inf``; with no eligible pod the router falls back to
+  the legacy ranking (no failure mode worse than today).
+- **Knobs-off parity** (the hard contract): ``BlendedRouter`` without a
+  predictor — and WITH one that abstains — decides bit-identically to
+  legacy; the scoring service with ``ROUTE_PREDICT`` unset reads no new
+  body fields and keeps its legacy response//stats keys.
+- **2-pod fleet acceptance**: real engines — the loaded warm pod loses
+  the route to the idle colder pod, and the colder pod's measured TTFT
+  wins.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from conftest import CharTokenizer
+from llm_d_kv_cache_manager_tpu.kvcache import (
+    BlendedRouter,
+    KVCacheIndexer,
+    KVCacheIndexerConfig,
+    PodSignals,
+    PredictionCorrector,
+    PrefixAffinityTracker,
+    TTFTPredictor,
+    TTFTPredictorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.keys import PodEntry
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    FleetHealth,
+    FleetHealthConfig,
+)
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
+from llm_d_kv_cache_manager_tpu.obs.audit import RouteAuditor
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    EngineConfig,
+    SamplingParams,
+    SchedulerConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.serve import PodServer, PodServerConfig
+
+PS = 4
+MODEL = "tiny-llama"
+RATE = 100.0  # tokens/s — makes the expected seconds easy to read
+
+
+def _pred(**kw):
+    kw.setdefault("block_size", PS)
+    # Unit tests pin raw model arithmetic; the tie band is exercised
+    # explicitly where it matters.
+    kw.setdefault("tie_band", 0.0)
+    kw.setdefault("tie_abs_s", 0.0)
+    return TTFTPredictor(TTFTPredictorConfig(**kw))
+
+
+def _sig(name, q=0.0, rate=RATE, **kw):
+    return PodSignals(name=name, queue_depth=q, prefill_rate=rate, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Predictor math
+# ---------------------------------------------------------------------------
+
+
+class TestPredictorMath:
+    def test_queue_term_scales_with_depth(self):
+        p = _pred()
+        arms = p.predict_routes(
+            [_sig("a", q=4), _sig("b", q=0)], 100, scores={}
+        )
+        # Work EMA seeds at the prompt (100 tokens → 1.0 s service).
+        # a: 4 queued x 1.0 + cold 100/100; b: cold only.
+        assert arms["b"].ttft_s == pytest.approx(1.0)
+        assert arms["a"].ttft_s == pytest.approx(5.0)
+
+    def test_concurrency_divides_the_queue_wait(self):
+        p = _pred(default_concurrency=4.0)
+        arms = p.predict_routes(
+            [_sig("a", q=4), _sig("b", q=0)], 100, scores={}
+        )
+        # 4 queued / width 4 = one service slot of wait, not four.
+        assert arms["a"].ttft_s == pytest.approx(2.0)
+
+    def test_miss_term_counts_the_unwarm_suffix(self):
+        p = _pred()
+        arms = p.predict_routes(
+            [_sig("a"), _sig("b")], 100, scores={"a": 10}
+        )
+        # a holds 10 blocks x 4 = 40 warm tokens → 60 to prefill.
+        assert arms["a"].ttft_s == pytest.approx(0.6)
+        assert arms["b"].ttft_s == pytest.approx(1.0)
+        assert arms["a"].action == "route_warm"
+
+    def test_warm_reuse_caps_at_prompt_minus_one(self):
+        p = _pred()
+        arms = p.predict_routes([_sig("a")], 100, scores={"a": 1000})
+        # The engine always computes one fresh position.
+        assert arms["a"].ttft_s == pytest.approx(1.0 / RATE)
+
+    def test_pull_arm_prices_the_wire_and_names_the_source(self):
+        p = _pred()
+        arms = p.predict_routes(
+            [_sig("a", q=10), _sig("b", q=0)],
+            100,
+            scores={"a": 10},
+            transfer_rate=1e6,
+            block_bytes=1000,
+        )
+        # b pulls a's 10 warm blocks: 10 KB over 1 MB/s = 0.01 s wire +
+        # 0.6 s suffix — beats b's 1.0 s cold arm.
+        assert arms["b"].action == "pull"
+        assert arms["b"].pull_source == "a"
+        assert arms["b"].pull_blocks == 10
+        assert arms["b"].ttft_s == pytest.approx(0.61)
+
+    def test_pull_arm_needs_a_measured_link(self):
+        p = _pred()
+        arms = p.predict_routes(
+            [_sig("a", q=10), _sig("b", q=0)], 100, scores={"a": 10}
+        )
+        # No transfer rate → the move can't be priced → no pull arm.
+        assert arms["b"].action == "route_warm"
+
+    def test_remote_holder_can_be_the_pull_source(self):
+        p = _pred()
+        arms = p.predict_routes(
+            [_sig("a", q=0)],
+            100,
+            scores={"a": 2},
+            remote_scores={"kvstore-0": 20},
+            remote_endpoint_of=lambda h: f"tcp://{h}",
+            transfer_rate=1e6,
+            block_bytes=1000,
+        )
+        assert arms["a"].action == "pull"
+        assert arms["a"].pull_source == "tcp://kvstore-0"
+
+    def test_abstains_until_any_rate_is_measured(self):
+        p = _pred()
+        assert (
+            p.predict_routes(
+                [_sig("a", rate=None), _sig("b", rate=None)], 100, {}
+            )
+            is None
+        )
+        assert p.snapshot()["abstained"] == 1
+        # No usable pod abstains AND counts (the /stats counter must
+        # surface every "legacy routing handled this" condition).
+        assert p.predict_routes([_sig("a", dead=True)], 100, {}) is None
+        assert p.snapshot()["abstained"] == 2
+
+    def test_negative_rate_is_unknown_not_a_negative_ttft(self):
+        p = _pred()
+        arms = p.predict_routes(
+            [_sig("bad", q=0, rate=-100.0), _sig("ok", q=2, rate=RATE)],
+            100,
+            {},
+        )
+        # The corrupt rate decays to the fallback: a negative modeled
+        # TTFT would win every route forever.
+        assert arms["bad"].ttft_s > 0
+        assert arms["bad"].ttft_s == pytest.approx(1.0)  # q=0, cold
+        # A negative QUEUE is corrupt too — clamping it to "idle" would
+        # convoy the fleet onto the broken pod; it decays to the
+        # conservative fallback (deepest fresh queue + 1).
+        arms2 = p.predict_routes(
+            [_sig("neg", q=-5.0), _sig("ok", q=2, rate=RATE)], 100, {}
+        )
+        assert arms2["neg"].ttft_s > arms2["ok"].ttft_s
+        # Negative rates alone can never arm the model.
+        assert (
+            p.predict_routes([_sig("x", rate=-5.0)], 100, {}) is None
+        )
+
+    def test_work_ema_tracks_prompt_lengths(self):
+        p = _pred(work_ema_alpha=0.5)
+        p.predict_routes([_sig("a")], 100, {})
+        p.predict_routes([_sig("a")], 200, {})
+        assert p.snapshot()["req_tokens_ema"] == pytest.approx(150.0)
+
+
+# ---------------------------------------------------------------------------
+# Corrector convergence (the injected rate lie)
+# ---------------------------------------------------------------------------
+
+
+class TestCorrector:
+    def test_converges_to_the_lie_ratio(self):
+        c = PredictionCorrector(alpha=0.5)
+        # Closed loop: the raw model says 1.0 s, reality says 2.0 s, and
+        # every new prediction applies the current bias. The fixed point
+        # is bias == the lie ratio.
+        for _ in range(40):
+            c.observe("liar", 1.0 * c.bias("liar"), 2.0)
+        assert c.bias("liar") == pytest.approx(2.0, rel=0.05)
+
+    def test_clamp_bounds_one_absurd_sample(self):
+        c = PredictionCorrector(alpha=1.0, lo=0.25, hi=4.0)
+        c.observe("a", 0.001, 100.0)
+        assert c.bias("a") == 4.0
+        c2 = PredictionCorrector(alpha=1.0, lo=0.25, hi=4.0)
+        c2.observe("a", 100.0, 0.001)
+        assert c2.bias("a") == 0.25
+        # A non-positive outcome is unusable, never a divide/flip.
+        c3 = PredictionCorrector(alpha=1.0)
+        assert c3.observe("a", 1.0, 0.0) is None
+        assert c3.bias("a") == 1.0
+
+    def test_unseen_pod_inherits_the_global_calibration_only(self):
+        c = PredictionCorrector(alpha=1.0)
+        c.observe("a", 1.0, 3.0)
+        # The GLOBAL factor (geometric, global_alpha = alpha/2) carries
+        # the fleet-systematic part to unseen pods; the per-pod residual
+        # (the lie detector) stays theirs alone.
+        assert c.bias("never-seen") == pytest.approx(3.0**0.5, rel=1e-3)
+        assert c.bias("a") > c.bias("never-seen")
+
+    def test_bias_scales_predictions(self):
+        p = _pred()
+        base = p.predict_routes([_sig("a")], 100, {})["a"].ttft_s
+        for _ in range(50):
+            p.corrector.observe("a", 1.0, 2.0)
+        scaled = p.predict_routes([_sig("a")], 100, {})["a"].ttft_s
+        assert scaled == pytest.approx(base * p.corrector.bias("a"))
+
+    def test_audit_join_feeds_the_corrector(self):
+        c = PredictionCorrector(alpha=1.0)
+        a = RouteAuditor(ttft_corrector=c)
+        a.record_decision(
+            "r1", chosen_pod="pa", predicted_blocks=2,
+            scoreboard={"pa": 2}, predicted_ttft_s=1.0,
+        )
+        rec = a.record_realized("r1", "pa", 2, realized_ttft_s=2.5)
+        assert rec.ttft_ratio == pytest.approx(2.5)
+        assert rec.predicted_ttft_s == 1.0 and rec.realized_ttft_s == 2.5
+        assert c.observed == 1 and c.bias("pa") > 1.0
+        assert a.snapshot()["ttft_ratio_p50"] == pytest.approx(2.5)
+        # The row surfaces the TTFT columns on /debug/audit.
+        (row,) = a.recent(request_id="r1")
+        assert row["ttft_ratio"] == pytest.approx(2.5)
+
+    def test_reroute_outcome_does_not_bias_the_chosen_pod(self):
+        c = PredictionCorrector(alpha=1.0)
+        a = RouteAuditor(ttft_corrector=c)
+        a.record_decision(
+            "r1", chosen_pod="pa", predicted_blocks=2,
+            scoreboard={"pa": 2}, predicted_ttft_s=1.0,
+        )
+        # The request landed on pb: pb's latency is not pa's model error.
+        rec = a.record_realized("r1", "pb", 0, realized_ttft_s=9.0)
+        assert c.observed == 0
+        # ...and the honesty ratio is not polluted either: the ratio's
+        # denominator is pa's prediction, which was never followed.
+        assert rec.ttft_ratio is None
+        assert "ttft_ratio_p50" not in a.snapshot()
+
+    def test_legacy_join_keeps_legacy_row_keys(self):
+        a = RouteAuditor()
+        a.record_decision(
+            "r1", chosen_pod="pa", predicted_blocks=1, scoreboard={"pa": 1}
+        )
+        rec = a.record_realized("r1", "pa", 1)
+        assert rec.ttft_ratio is None
+        (row,) = a.recent(request_id="r1")
+        assert "ttft_ratio" not in row and "predicted_ttft_s" not in row
+        assert "ttft_ratio_p50" not in a.snapshot()
+
+    def test_router_corrects_an_injected_rate_lie(self):
+        """End-to-end convergence: a pod whose heartbeat claims 2x its
+        real prefill rate keeps winning until the audit joins teach its
+        residual, then routing fails over to the honest pod."""
+        p = _pred(tie_band=0.0, tie_abs_s=0.0)
+        auditor = RouteAuditor(ttft_corrector=p.corrector)
+        sigs = {
+            # Equal queues; "liar" claims double the real rate.
+            "liar": _sig("liar", q=2, rate=2 * RATE),
+            "honest": _sig("honest", q=2, rate=RATE),
+        }
+        router = BlendedRouter(
+            score_fn=lambda toks, names: {},
+            affinity=PrefixAffinityTracker(
+                2, 64,
+                token_processor=ChunkedTokenDatabase(
+                    TokenProcessorConfig(block_size=PS)
+                ),
+            ),
+            loads_fn=lambda names: [2.0, 2.0],
+            auditor=auditor,
+            predictor=p,
+            signals_fn=lambda names: [sigs[n] for n in names],
+        )
+        pods = ["liar", "honest"]
+        toks = list(range(40))
+        # Both pods' TRUE latency at the honest rate: 2 queued + the
+        # prompt = 120 tokens at 100 tok/s; the liar's claim halves it.
+        truth = 1.2
+        first = router.route(toks, pods, request_id="lie-0")
+        assert first.pod == "liar"  # the lie wins at face value
+        auditor.record_realized("lie-0", "liar", 0, realized_ttft_s=1.5)
+        failed_over = False
+        for i in range(1, 40):
+            rid = f"lie-{i}"
+            decision = router.route(toks, pods, request_id=rid)
+            if decision.pod == "honest":
+                failed_over = True
+                break
+            # The liar's joins keep exposing the lie...
+            auditor.record_realized(rid, "liar", 0, realized_ttft_s=1.5)
+            # ...while background traffic on the honest pod confirms
+            # the model there (realized == its true latency), keeping
+            # its residual honest as the global factor drifts.
+            hrid = f"bg-{i}"
+            auditor.record_decision(
+                hrid, chosen_pod="honest", predicted_blocks=0,
+                scoreboard={},
+                predicted_ttft_s=truth * p.corrector.bias("honest"),
+            )
+            auditor.record_realized(hrid, "honest", 0, realized_ttft_s=truth)
+        assert failed_over
+        assert p.corrector.bias("liar") > p.corrector.bias("honest")
+
+
+# ---------------------------------------------------------------------------
+# Stale-heartbeat degradation (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestStaleHeartbeat:
+    def test_stale_signals_decay_to_conservative_defaults(self):
+        p = _pred(heartbeat_interval_s=1.0)
+        arms = p.predict_routes(
+            [
+                # Frozen heartbeat: shallow queue + fast rate, 2.5 s old.
+                _sig("stale", q=0, rate=10 * RATE, signal_age_s=2.5),
+                _sig("fresh", q=8, rate=RATE, signal_age_s=0.2),
+            ],
+            100,
+            {},
+        )
+        # The stale pod decays to the deepest fresh queue PLUS ONE and
+        # the slowest fresh rate — unknown reads strictly worse than the
+        # worst pod we have live signals for, so a frozen shallow queue
+        # can never even tie its way back into winning.
+        assert arms["stale"].ttft_s > arms["fresh"].ttft_s
+
+    def test_age_within_two_beats_is_trusted(self):
+        p = _pred(heartbeat_interval_s=1.0)
+        arms = p.predict_routes(
+            [
+                _sig("young", q=0, rate=10 * RATE, signal_age_s=1.9),
+                _sig("fresh", q=8, rate=RATE, signal_age_s=0.2),
+            ],
+            100,
+            {},
+        )
+        assert arms["young"].ttft_s < arms["fresh"].ttft_s
+
+    def test_every_signal_stale_abstains(self):
+        p = _pred(heartbeat_interval_s=1.0)
+        assert (
+            p.predict_routes(
+                [_sig("a", signal_age_s=5.0), _sig("b", signal_age_s=9.0)],
+                100,
+                {},
+            )
+            is None
+        )
+
+    def test_frozen_heartbeat_regression_with_fleet_health(self):
+        """The satellite's regression: pod-a's heartbeat freezes while
+        advertising an empty queue; the router must stop chasing it."""
+        now = [1.0]
+        fh = FleetHealth(FleetHealthConfig(), clock=lambda: now[0])
+        fh.observe_heartbeat("pod-a", 0)
+        telemetry = {
+            "pod-a": (0.0, 10 * RATE),  # frozen claim: idle and fast
+            "pod-b": (3.0, RATE),
+        }
+
+        def signals(names):
+            views = fh.signal_views()
+            return [
+                PodSignals(
+                    name=n,
+                    queue_depth=telemetry[n][0],
+                    prefill_rate=telemetry[n][1],
+                    draining=views.get(n, {}).get("draining", False),
+                    dead=views.get(n, {}).get("expired", False),
+                    signal_age_s=views.get(n, {}).get("age_s"),
+                )
+                for n in names
+            ]
+
+        def router(hb):
+            return BlendedRouter(
+                score_fn=lambda toks, names: {},
+                affinity=PrefixAffinityTracker(
+                    2, 64,
+                    token_processor=ChunkedTokenDatabase(
+                        TokenProcessorConfig(block_size=PS)
+                    ),
+                ),
+                loads_fn=lambda names: [telemetry[n][0] for n in names],
+                predictor=_pred(heartbeat_interval_s=hb),
+                signals_fn=signals,
+            )
+
+        pods = ["pod-a", "pod-b"]
+        toks = list(range(40))
+        # pod-a heartbeats stop; pod-b keeps beating for 5 intervals.
+        for _ in range(5):
+            now[0] += 1.0
+            fh.observe_heartbeat("pod-b", 0)
+        # Without the staleness gate the frozen "idle + fast" claim wins.
+        assert router(hb=0.0).route(toks, pods).pod == "pod-a"
+        # With it, pod-a's signals are unknown → conservative defaults
+        # (pod-b's queue + rate), the tie resolves by live load → pod-b.
+        assert router(hb=1.0).route(toks, pods).pod == "pod-b"
+
+
+# ---------------------------------------------------------------------------
+# Never-pick gates + legacy fallback
+# ---------------------------------------------------------------------------
+
+
+class TestNeverPick:
+    def _router(self, sigs, loads=None, score_fn=None, predictor=None):
+        names = [s.name for s in sigs]
+        loads = loads or {n: 0.0 for n in names}
+        return BlendedRouter(
+            score_fn=score_fn or (lambda toks, p: {}),
+            affinity=PrefixAffinityTracker(
+                len(names), 64,
+                token_processor=ChunkedTokenDatabase(
+                    TokenProcessorConfig(block_size=PS)
+                ),
+            ),
+            loads_fn=lambda p: [loads[n] for n in p],
+            predictor=predictor or _pred(),
+            signals_fn=lambda p: list(sigs),
+        )
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            dict(dead=True),
+            dict(draining=True),
+            dict(role="kvstore"),
+            dict(admitting=False),
+        ],
+    )
+    def test_ineligible_pod_never_picked_even_when_warm(self, bad):
+        sigs = [_sig("bad", q=0, **bad), _sig("ok", q=50)]
+        router = self._router(
+            sigs, score_fn=lambda toks, p: {"bad": 100}
+        )
+        # All the warmth and an empty queue live on the ineligible pod;
+        # the eligible one is deeply queued — and still wins.
+        assert router.route(list(range(40)), ["bad", "ok"]).pod == "ok"
+
+    def test_no_eligible_pod_falls_back_to_legacy_ranking(self):
+        sigs = [_sig("a", dead=True), _sig("b", dead=True)]
+        router = self._router(sigs, loads={"a": 5.0, "b": 1.0})
+        # Prediction has no candidate; the legacy load ranking still
+        # serves the request (no failure mode worse than today).
+        decision = router.route(list(range(40)), ["a", "b"])
+        assert decision.pod == "b"
+        assert decision.predicted_ttft_s is None
+
+    def test_tie_band_keeps_warmth_on_noise_deltas(self):
+        p = _pred(tie_band=0.5, tie_abs_s=0.0)
+        sigs = [_sig("warm", q=1), _sig("cold", q=0)]
+        router = self._router(
+            sigs, score_fn=lambda toks, pods_: {"warm": 9}, predictor=p
+        )
+        # cold predicts slightly better, but within the band the legacy
+        # ranking (warmth first) holds the group together.
+        decision = router.route(list(range(40)), ["warm", "cold"])
+        assert decision.pod == "warm"
+
+
+# ---------------------------------------------------------------------------
+# Knobs-off parity
+# ---------------------------------------------------------------------------
+
+
+class TestKnobsOffParity:
+    def _pair(self, with_predictor):
+        ix = KVCacheIndexer(
+            KVCacheIndexerConfig(
+                token_processor=TokenProcessorConfig(block_size=PS)
+            )
+        )
+        loads = {"a": 1.0, "b": 0.0, "c": 2.0}
+        kw = {}
+        if with_predictor:
+            # A predictor whose signals never carry a measured rate
+            # ABSTAINS on every decision — the contract is bit-identical
+            # legacy routing.
+            kw = dict(
+                predictor=_pred(),
+                signals_fn=lambda names: [
+                    PodSignals(name=n, queue_depth=loads[n]) for n in names
+                ],
+            )
+        router = BlendedRouter(
+            score_fn=lambda toks, p: ix.score_tokens(toks, MODEL, p),
+            affinity=PrefixAffinityTracker(
+                3, 64,
+                token_processor=ChunkedTokenDatabase(
+                    TokenProcessorConfig(block_size=PS)
+                ),
+            ),
+            loads_fn=lambda p: [loads[x] for x in p],
+            **kw,
+        )
+        return ix, router
+
+    def test_abstaining_predictor_is_bit_identical_legacy(self):
+        ix1, legacy = self._pair(with_predictor=False)
+        ix2, predict = self._pair(with_predictor=True)
+        pods = ["a", "b", "c"]
+        keys = ix1.token_processor.tokens_to_kv_block_keys(
+            list(range(16)), MODEL
+        )
+        for ix in (ix1, ix2):
+            ix.kv_block_index.add(keys, [PodEntry("c", "tpu_hbm")])
+        try:
+            for toks in (
+                list(range(16)), list(range(16)), list(range(80, 96)),
+                list(range(200, 232)),
+            ):
+                d1 = legacy.route(toks, pods)
+                d2 = predict.route(toks, pods)
+                assert (d1.pod, d1.action, d1.index_score, d1.affinity_score) == (
+                    d2.pod, d2.action, d2.index_score, d2.affinity_score
+                )
+                assert d2.predicted_ttft_s is None
+        finally:
+            ix1.shutdown()
+            ix2.shutdown()
+
+    def test_scoring_service_knob_off_ignores_signals(self):
+        from llm_d_kv_cache_manager_tpu.server.api import (
+            ScoringService,
+            ServiceConfig,
+        )
+
+        svc = ScoringService(
+            ServiceConfig(native_index=False, enable_metrics=False),
+            tokenizer=CharTokenizer(),
+        )
+        assert svc.predictor is None
+        svc.indexer.get_pod_scores = (
+            lambda prompt, model, pods, placement=None: {"pa": 1}
+        )
+
+        async def runner():
+            ts = TestServer(svc.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/score_completions",
+                    json={
+                        "prompt": "x" * 16,
+                        "model": MODEL,
+                        "signals": [
+                            {"pod": "pa", "queue_depth": 1,
+                             "prefill_rate": 100},
+                        ],
+                    },
+                )
+                data = await resp.json()
+                assert set(data) == {"scores"}
+                stats = await (await client.get("/stats")).json()
+                assert "predict" not in stats
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            svc.indexer.shutdown()
+
+    def test_scoring_service_route_predict_serves_predicted_ttft(self):
+        from llm_d_kv_cache_manager_tpu.server.api import (
+            ScoringService,
+            ServiceConfig,
+        )
+
+        svc = ScoringService(
+            ServiceConfig(
+                native_index=False, enable_metrics=False,
+                route_predict=True, block_size=PS,
+            ),
+            tokenizer=CharTokenizer(),
+        )
+        assert svc.predictor is not None
+        svc.indexer.score_tokens = (
+            lambda toks, model, pods, placement=None: {"pa": 2, "pb": 0}
+        )
+        # The predict path tokenizes (prompt length feeds the miss
+        # term): the pool's workers must be live, as start() makes them.
+        svc.indexer.run()
+
+        async def runner():
+            ts = TestServer(svc.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/score_completions",
+                    json={
+                        "prompt": "x" * 16,
+                        "model": MODEL,
+                        "signals": [
+                            {"pod": "pa", "queue_depth": 0,
+                             "prefill_rate": 100},
+                            {"pod": "pb", "queue_depth": 8,
+                             "prefill_rate": 100},
+                        ],
+                    },
+                )
+                data = await resp.json()
+                assert set(data) == {"scores", "predicted_ttft_s"}
+                pred = data["predicted_ttft_s"]
+                # Warm + idle beats cold + queued.
+                assert pred["pa"] < pred["pb"]
+                # A signals row naming a pod outside pod_identifiers is
+                # dropped: predicted_ttft_s must never steer the caller
+                # toward a pod the scoreboard's filters rejected.
+                resp = await client.post(
+                    "/score_completions",
+                    json={
+                        "prompt": "x" * 16,
+                        "model": MODEL,
+                        "pod_identifiers": ["pa"],
+                        "signals": [
+                            {"pod": "pa", "queue_depth": 0,
+                             "prefill_rate": 100},
+                            {"pod": "rogue", "queue_depth": 0,
+                             "prefill_rate": 100},
+                        ],
+                    },
+                )
+                data = await resp.json()
+                assert set(data["predicted_ttft_s"]) == {"pa"}
+                # Without signals the response keeps its legacy keys
+                # even with the knob on.
+                resp = await client.post(
+                    "/score_completions",
+                    json={"prompt": "x" * 16, "model": MODEL},
+                )
+                assert set(await resp.json()) == {"scores"}
+                stats = await (await client.get("/stats")).json()
+                assert "predict" in stats
+                assert stats["predict"]["predictions"] >= 1
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            svc.indexer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 2-pod fleet acceptance: the loaded warm pod loses, and rightly so
+# ---------------------------------------------------------------------------
+
+
+class TestFleetAcceptance:
+    def _pod_config(self, pod_id):
+        return PodServerConfig(
+            model_name=MODEL,
+            pod_identifier=pod_id,
+            publish_events=False,
+            engine=EngineConfig(
+                model=TINY_LLAMA,
+                block_manager=BlockManagerConfig(
+                    total_pages=128, page_size=PS
+                ),
+                scheduler=SchedulerConfig(max_prefill_batch=2),
+                max_model_len=96,
+                decode_batch_size=2,
+                prefill_bucket=8,
+                interpret=True,
+            ),
+        )
+
+    def test_loaded_warm_pod_loses_to_idle_cold_pod_and_ttft_agrees(self):
+        indexer = KVCacheIndexer(
+            KVCacheIndexerConfig(
+                token_processor=TokenProcessorConfig(block_size=PS)
+            )
+        )
+        pods = {
+            "pod-a": PodServer(self._pod_config("pod-a")),
+            "pod-b": PodServer(self._pod_config("pod-b")),
+        }
+        for p in pods.values():
+            p.start()
+        prefix = [(37 * i + 11) % 256 for i in range(32)]
+        try:
+            # Warm pod-a's prefix cache and its prefill-rate EMA.
+            pods["pod-a"].generate(
+                prefix + [1, 2, 3, 4], SamplingParams(max_new_tokens=2),
+                timeout=120,
+            )
+            keys = indexer.token_processor.tokens_to_kv_block_keys(
+                prefix, MODEL
+            )
+            indexer.kv_block_index.add(keys, [PodEntry("pod-a", "tpu_hbm")])
+            # Load pod-a with a backlog (past its 2-wide batch).
+            backlog = [
+                pods["pod-a"].submit(
+                    [(53 * (i + 7) + j) % 256 for j in range(36)],
+                    SamplingParams(max_new_tokens=12),
+                )
+                for i in range(8)
+            ]
+            # default_concurrency stays 1: PodServer.prefill_rate is the
+            # engine's batch-aggregate EMA, already width-amortized.
+            predictor = TTFTPredictor(TTFTPredictorConfig(block_size=PS))
+            router = BlendedRouter(
+                score_fn=lambda toks, names: indexer.score_tokens(
+                    toks, MODEL, names
+                ),
+                affinity=PrefixAffinityTracker(
+                    2, 64,
+                    token_processor=ChunkedTokenDatabase(
+                        TokenProcessorConfig(block_size=PS)
+                    ),
+                ),
+                loads_fn=lambda names: [
+                    pods[n].queue_depth for n in names
+                ],
+                predictor=predictor,
+                signals_fn=lambda names: [
+                    PodSignals(
+                        name=n,
+                        queue_depth=float(pods[n].queue_depth),
+                        prefill_rate=pods[n].prefill_rate,
+                    )
+                    for n in names
+                ],
+            )
+            prompt = prefix + [9, 8, 7, 6]
+            decision = router.route(prompt, ["pod-a", "pod-b"])
+            # Legacy score-max would queue behind the warmth; predicted
+            # routing sends the request to the idle colder pod.
+            assert decision.pod == "pod-b"
+            assert decision.predicted_ttft_s is not None
+            # Ground truth: identical probes on both pods — the idle
+            # cold pod's measured TTFT beats the loaded warm pod's.
+            fut_b = pods["pod-b"].submit(
+                list(prompt), SamplingParams(max_new_tokens=2)
+            )
+            fut_a = pods["pod-a"].submit(
+                list(prompt), SamplingParams(max_new_tokens=2)
+            )
+            seq_b = fut_b.result(timeout=300)
+            seq_a = fut_a.result(timeout=300)
+            assert seq_b.ttft is not None and seq_a.ttft is not None
+            assert seq_b.ttft < seq_a.ttft
+            for f in backlog:
+                f.result(timeout=300)
+        finally:
+            for p in pods.values():
+                p.shutdown()
+            indexer.shutdown()
