@@ -111,6 +111,11 @@ class FleetHealth:
         self._any_kvstore = False  # guarded_by: _mu
         self._sweep_thread: Optional[threading.Thread] = None
         self._sweep_stop = threading.Event()
+        #: optional observer ``(pod) -> None`` fired after a dead pod is
+        #: swept from the index (the OBS_LIFECYCLE ledger ends the pod's
+        #: tracked residencies through it). Called OUTSIDE the lock; a
+        #: raising observer must not break the sweep.
+        self.on_pod_swept = None
 
     # -- ingestion-side observations (called from pool workers) -------------
     def observe_message(self, pod: str, model: str, seq: int) -> bool:
@@ -523,6 +528,12 @@ class FleetHealth:
                 self.pods_swept += 1
             collector.bump("fleet_pods_swept")
             collector.fleet_pods_swept.inc()
+            cb = self.on_pod_swept
+            if cb is not None:
+                try:
+                    cb(pod)
+                except Exception:
+                    log.exception("on_pod_swept observer failed", pod=pod)
             log.warning("swept dead pod from index", pod=pod, ttl_s=ttl)
         return swept
 

@@ -80,7 +80,11 @@ class KVEventsPool:
     heartbeats, resync acknowledgements. ``staleness`` (optional, an
     ``obs.StalenessTracker``) records publish→apply lag per (pod, event
     type) plus received/applied seq high-waters; ``audit`` (optional, an
-    ``obs.RouteAuditor``) receives ``RequestAudit`` realized-hit reports.
+    ``obs.RouteAuditor``) receives ``RequestAudit`` realized-hit reports;
+    ``lifecycle`` (optional, an ``obs.lifecycle.BlockLifecycleLedger``)
+    receives the per-pod ``BlockStored``/``BlockRemoved`` tier story —
+    the scorer-side half of the OBS_LIFECYCLE ledger, derived from the
+    stream this pool already decodes (no new wire fields).
     All ``None`` (default) keeps the legacy behavior bit-identical.
     """
 
@@ -92,6 +96,7 @@ class KVEventsPool:
         *,
         staleness=None,
         audit=None,
+        lifecycle=None,
     ):
         self.config = config or KVEventsPoolConfig()
         if self.config.concurrency < 1:
@@ -100,6 +105,7 @@ class KVEventsPool:
         self.health = health
         self.staleness = staleness
         self.audit = audit
+        self.lifecycle = lifecycle
         self._mu = threading.Lock()
         #: tasks rejected because the pool was already shut down — after the
         #: poison pill a task would sit unprocessed forever, which is worse
@@ -227,6 +233,10 @@ class KVEventsPool:
                         exc_info=True,
                         pod=msg.pod_identifier,
                     )
+                if self.lifecycle is not None:
+                    self.lifecycle.observe_stored(
+                        msg.pod_identifier, ev.block_hashes, ev.medium
+                    )
             elif isinstance(ev, BlockRemoved):
                 if ev.medium is None:
                     # No medium (incl. legacy events) = the pod no longer
@@ -246,6 +256,10 @@ class KVEventsPool:
                             exc_info=True,
                             pod=msg.pod_identifier,
                         )
+                if self.lifecycle is not None:
+                    self.lifecycle.observe_removed(
+                        msg.pod_identifier, ev.block_hashes, ev.medium
+                    )
             elif isinstance(ev, Heartbeat):
                 if self.health is not None:
                     self.health.observe_heartbeat(
@@ -279,6 +293,12 @@ class KVEventsPool:
                     )
                 if self.health is not None:
                     self.health.observe_drained(msg.pod_identifier)
+                if self.lifecycle is not None:
+                    # The ledger must not keep a drained pod's blocks
+                    # "resident" forever — end every tracked residency.
+                    self.lifecycle.observe_pod_gone(
+                        msg.pod_identifier, "drained"
+                    )
                 log.info(
                     "pod drained; evicted from index", pod=msg.pod_identifier
                 )
@@ -328,6 +348,10 @@ class KVEventsPool:
                 pod=msg.pod_identifier,
             )
             return
+        if self.lifecycle is not None:
+            # Replace-all means replace-all in the ledger too: end every
+            # tracked residency, then re-open exactly the digest's.
+            self.lifecycle.observe_pod_gone(msg.pod_identifier, "resync")
         for medium, hashes in ev.blocks_by_medium.items():
             if not hashes:
                 continue
@@ -342,6 +366,10 @@ class KVEventsPool:
                     exc_info=True,
                     pod=msg.pod_identifier,
                     medium=medium,
+                )
+            if self.lifecycle is not None:
+                self.lifecycle.observe_stored(
+                    msg.pod_identifier, hashes, medium
                 )
         if self.health is not None:
             self.health.observe_resync(msg.pod_identifier)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ...obs.lifecycle import COLD_DISTANCE_CLAMP, REUSE_DISTANCE_BUCKETS
 from ...utils import get_logger
 
 log = get_logger("kvcache.metrics")
@@ -99,6 +100,14 @@ route_ttft_ratio = _NullMetric()
 shard_blocks = _NullMetric()
 shard_pods = _NullMetric()
 shard_misroutes = _NullMetric()
+# KV-capacity observability plane (ISSUE 15): block tier transitions +
+# per-tier residency from the lifecycle ledger, and the sampled
+# reuse-distance histogram behind the MRC. Series appear only when
+# OBS_LIFECYCLE attaches the ledger/estimator — a knobs-off process never
+# touches a label.
+block_transitions = _NullMetric()
+block_residency = _NullMetric()
+reuse_distance = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -138,6 +147,7 @@ def register(registry=None) -> None:
     global route_pvr, route_regret, route_miss
     global route_predicted_ttft, route_ttft_ratio
     global shard_blocks, shard_pods, shard_misroutes
+    global block_transitions, block_residency, reuse_distance
     with _lock:
         if _registered:
             return
@@ -332,6 +342,34 @@ def register(registry=None) -> None:
             ["shard"],
             registry=registry,
         )
+        block_transitions = _prom.Counter(
+            "kvcache_block_tier_transitions_total",
+            "KV-block tier transitions recorded by the lifecycle ledger "
+            "(OBS_LIFECYCLE): from/to in {none, tpu_hbm, host_dram, "
+            "remote}, reason = allocate/import/spill/restore/prefetch/"
+            "demote/demote_failed/evict (pod hooks) or stored/removed/"
+            "drained/resync/ttl_swept (scorer event feed)",
+            ["from", "to", "reason"],
+            registry=registry,
+        )
+        block_residency = _prom.Histogram(
+            "kvcache_block_tier_residency_seconds",
+            "How long a KV block stayed resident in a tier before "
+            "leaving it (observed at departure; OBS_LIFECYCLE)",
+            ["tier"],
+            registry=registry,
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 300.0, 600.0, 1800.0, 3600.0),
+        )
+        reuse_distance = _prom.Histogram(
+            "kvcache_reuse_distance_blocks",
+            "Sampled LRU stack distance of prefix-block lookups, in "
+            "blocks (OBS_LIFECYCLE): P[distance < C] is the modeled hit "
+            "rate of a C-block tier — the MRC behind /debug/mrc; cold "
+            "first-ever accesses land in +Inf",
+            registry=registry,
+            buckets=tuple(float(b) for b in REUSE_DISTANCE_BUCKETS),
+        )
         _registered = True
 
 
@@ -395,6 +433,26 @@ def observe_ttft_ratio(ratio: float) -> None:
 def observe_miss_cause(cause: str) -> None:
     bump(f"route_miss_{cause}")
     route_miss.labels(cause=cause).inc()
+
+
+def observe_tier_transition(frm: str, to: str, reason: str) -> None:
+    """One lifecycle-ledger tier transition (OBS_LIFECYCLE). Keyword
+    form avoided: ``from`` is a Python keyword, so the label rides
+    positionally via labels(frm, to, reason)."""
+    bump("block_transitions")
+    block_transitions.labels(frm, to, reason).inc()
+
+
+def observe_tier_residency(tier: str, seconds: float) -> None:
+    block_residency.labels(tier=tier).observe(seconds)
+
+
+def observe_reuse_distance(distance_blocks: float) -> None:
+    """One sampled reuse distance (inf = cold first-ever access). Cold
+    accesses are clamped to a finite over-the-top value so they land in
+    the +Inf bucket without poisoning the ``_sum`` series with inf."""
+    bump("reuse_distances")
+    reuse_distance.observe(min(distance_blocks, COLD_DISTANCE_CLAMP))
 
 
 def set_index_size(blocks: int, pods: int) -> None:

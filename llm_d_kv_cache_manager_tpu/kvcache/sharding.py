@@ -480,6 +480,7 @@ class ShardedEventsPool:
         *,
         staleness: Optional[Sequence] = None,
         audit=None,
+        lifecycle=None,
         instrument: bool = False,
     ):
         """``instrument=True`` keeps the admission/eviction counters in
@@ -492,6 +493,10 @@ class ShardedEventsPool:
         self.index = index
         self.health = health
         self.audit = audit
+        #: OBS_LIFECYCLE ledger (obs/lifecycle.py): fed at the decode
+        #: stage (per-pod dispatcher order, same vantage as health), so
+        #: the sharded plane's block tier story matches the single pool's.
+        self.lifecycle = lifecycle
         self.instrument = instrument
         self.staleness = list(staleness) if staleness else None
         if self.staleness is not None and len(self.staleness) != index.n_shards:
@@ -678,6 +683,10 @@ class ShardedEventsPool:
                     touched.add(shard)
                 for shard in touched:
                     task_for(shard).tags.append("BlockStored")
+                if self.lifecycle is not None:
+                    self.lifecycle.observe_stored(
+                        msg.pod_identifier, ev.block_hashes, ev.medium
+                    )
             elif isinstance(ev, BlockRemoved):
                 flush_adds()
                 if ev.medium is None:
@@ -693,6 +702,10 @@ class ShardedEventsPool:
                     touched.add(shard)
                 for shard in touched:
                     tasks[shard].tags.append("BlockRemoved")
+                if self.lifecycle is not None:
+                    self.lifecycle.observe_removed(
+                        msg.pod_identifier, ev.block_hashes, ev.medium
+                    )
             elif isinstance(ev, Heartbeat):
                 if self.health is not None:
                     self.health.observe_heartbeat(
@@ -723,6 +736,16 @@ class ShardedEventsPool:
                         digests[ring.owner(h)].setdefault(medium, []).append(h)
                 if self.health is not None:
                     self.health.observe_resync(msg.pod_identifier)
+                if self.lifecycle is not None:
+                    # Replace-all in the ledger too (single-pool rule).
+                    self.lifecycle.observe_pod_gone(
+                        msg.pod_identifier, "resync"
+                    )
+                    for medium, hashes in ev.blocks_by_medium.items():
+                        if hashes:
+                            self.lifecycle.observe_stored(
+                                msg.pod_identifier, hashes, medium
+                            )
             elif isinstance(ev, PodDrained):
                 flush_adds()
                 for shard in range(self.index.n_shards):
@@ -731,6 +754,10 @@ class ShardedEventsPool:
                     t.tags.append("PodDrained")
                 if self.health is not None:
                     self.health.observe_drained(msg.pod_identifier)
+                if self.lifecycle is not None:
+                    self.lifecycle.observe_pod_gone(
+                        msg.pod_identifier, "drained"
+                    )
                 log.info("pod drained; evicted from index", pod=msg.pod_identifier)
             elif isinstance(ev, RequestAudit):
                 if self.audit is not None:

@@ -93,6 +93,10 @@ class CircuitBreaker:
         self._probe_inflight = False  # guarded_by: _mu
         self.opens = 0  # guarded_by: _mu
         self.closes = 0  # guarded_by: _mu
+        #: optional transition observer ``(state: "open"|"closed") -> None``
+        #: (the OBS_FLIGHT recorder's breaker trigger); called OUTSIDE the
+        #: lock, only on actual transitions. None (default) = legacy.
+        self.on_transition = None
 
     @property
     def state(self) -> str:
@@ -135,6 +139,12 @@ class CircuitBreaker:
         if recovered:
             collector.bump("breaker_closes")
             collector.breaker_closes.inc()
+            cb = self.on_transition
+            if cb is not None:
+                try:
+                    cb("closed")
+                except Exception:
+                    log.exception("breaker on_transition callback failed")
 
     def record_failure(self) -> None:
         opened = False
@@ -160,6 +170,12 @@ class CircuitBreaker:
         if opened:
             collector.bump("breaker_opens")
             collector.breaker_opens.inc()
+            cb = self.on_transition
+            if cb is not None:
+                try:
+                    cb("open")
+                except Exception:
+                    log.exception("breaker on_transition callback failed")
 
     def snapshot(self) -> dict:
         with self._mu:

@@ -29,6 +29,10 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..utils import get_logger
+
+log = get_logger("obs.slo")
+
 SLO_METRICS = ("ttft", "itl")
 DEFAULT_WINDOWS_S = (60.0, 300.0)
 
@@ -94,7 +98,19 @@ class SLORecorder:
         windows_s=DEFAULT_WINDOWS_S,
         clock: Callable[[], float] = time.monotonic,
         max_samples_per_objective: int = 65536,
+        on_burn: Optional[Callable[[str, str, float], None]] = None,
+        burn_threshold: float = 0.0,
+        burn_check_interval_s: float = 1.0,
     ):
+        """``on_burn(objective, window, rate)`` (optional, e.g. the
+        ``OBS_FLIGHT`` recorder's trigger): fired when any objective's
+        burn rate CROSSES ``burn_threshold`` from below — edge-triggered
+        per (objective, window), so a sustained burn triggers once until
+        it recovers under the threshold. Evaluation is throttled to at
+        most once per ``burn_check_interval_s`` (burn rates are
+        O(window samples) to compute, which must not ride every request).
+        ``burn_threshold <= 0`` or ``on_burn=None`` disables the check —
+        the legacy observe path reads no extra state."""
         self.objectives = list(objectives)
         self.windows_s = tuple(windows_s)
         self._clock = clock
@@ -105,6 +121,14 @@ class SLORecorder:
             for o in self.objectives
         }
         self.observed = 0  # guarded_by: _mu
+        self.on_burn = on_burn
+        self.burn_threshold = float(burn_threshold)
+        self._burn_check_interval_s = float(burn_check_interval_s)
+        self._next_burn_check = 0.0  # guarded_by: _mu
+        #: (objective, window) currently at-or-over the threshold (the
+        #: edge detector's state)
+        self._burning: set[tuple[str, str]] = set()  # guarded_by: _mu
+        self.burn_crossings = 0  # guarded_by: _mu
 
     def observe(
         self, ttft_s: Optional[float], itl_s: Optional[float]
@@ -113,6 +137,7 @@ class SLORecorder:
         this request, e.g. single-token generations have no ITL)."""
         now = self._clock()
         values = {"ttft": ttft_s, "itl": itl_s}
+        check_burn = False
         with self._mu:
             self.observed += 1
             horizon = now - max(self.windows_s)
@@ -124,6 +149,15 @@ class SLORecorder:
                 ev.append((now, v > obj.threshold_s))
                 while ev and ev[0][0] < horizon:
                     ev.popleft()
+            if (
+                self.on_burn is not None
+                and self.burn_threshold > 0
+                and now >= self._next_burn_check
+            ):
+                self._next_burn_check = now + self._burn_check_interval_s
+                check_burn = True
+        if check_burn:
+            self._check_burn_crossings()
 
     def burn_rates(self) -> dict[str, dict[str, Optional[float]]]:
         """{objective label: {window label: burn rate | None}} — None when
@@ -148,6 +182,33 @@ class SLORecorder:
                     )
                 out[obj.label] = rates
         return out
+
+    def _check_burn_crossings(self) -> None:
+        """Edge-triggered burn-threshold detector: fires ``on_burn`` once
+        per (objective, window) crossing; a window that recovers below
+        the threshold re-arms. Called off the observe path (throttled) so
+        the O(samples) burn-rate walk never rides every request."""
+        fired: list[tuple[str, str, float]] = []
+        rates = self.burn_rates()
+        with self._mu:
+            for objective, windows in rates.items():
+                for window, rate in windows.items():
+                    key = (objective, window)
+                    if rate is not None and rate >= self.burn_threshold:
+                        if key not in self._burning:
+                            self._burning.add(key)
+                            self.burn_crossings += 1
+                            fired.append((objective, window, rate))
+                    else:
+                        self._burning.discard(key)
+            cb = self.on_burn
+        for objective, window, rate in fired:
+            try:
+                cb(objective, window, rate)
+            except Exception:
+                # The callback (a flight-recorder dump) must never fail
+                # the request whose observation tripped it.
+                log.exception("on_burn callback failed")
 
     def sync_gauges(self, set_fn: Callable[[str, str, float], None]) -> None:
         """Push current burn rates into labeled gauges (scrape-driven).

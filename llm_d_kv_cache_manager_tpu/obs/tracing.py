@@ -264,11 +264,15 @@ class Tracer:
         self,
         trace_id: Optional[str] = None,
         request_id: Optional[str] = None,
+        span_name: Optional[str] = None,
         limit: int = 50,
     ) -> list[dict]:
         """Finished spans grouped by trace (oldest trace first). A
         ``request_id`` filter keeps traces where ANY span carries that
-        ``request_id`` attribute."""
+        ``request_id`` attribute; a ``span_name`` filter keeps traces
+        containing a span of that name (the whole trace is returned, so
+        the match stays readable in context — grepping the disagg
+        two-hop traces by ``span=disagg.handoff`` beats hunting ids)."""
         if limit <= 0:
             return []
         with self._mu:
@@ -282,6 +286,10 @@ class Tracer:
                 continue
             if request_id is not None and not any(
                 r["attrs"].get("request_id") == request_id for r in recs
+            ):
+                continue
+            if span_name is not None and not any(
+                r["name"] == span_name for r in recs
             ):
                 continue
             out.append({"trace_id": tid, "spans": recs})
@@ -300,8 +308,8 @@ class Tracer:
 def debug_traces_payload(tracer: Tracer, query) -> tuple[int, dict]:
     """The shared ``GET /debug/traces`` contract for the scoring API and
     the pod server: ``(http_status, payload)`` from a query mapping with
-    optional ``trace_id`` / ``request_id`` / ``limit`` keys. Framework-
-    agnostic so both aiohttp handlers stay one line."""
+    optional ``trace_id`` / ``request_id`` / ``span`` / ``limit`` keys.
+    Framework-agnostic so both aiohttp handlers stay one line."""
     try:
         limit = int(query.get("limit", "50"))
     except ValueError:
@@ -311,6 +319,7 @@ def debug_traces_payload(tracer: Tracer, query) -> tuple[int, dict]:
         "traces": tracer.traces(
             trace_id=query.get("trace_id"),
             request_id=query.get("request_id"),
+            span_name=query.get("span"),
             limit=limit,
         ),
     }

@@ -108,6 +108,15 @@ class ServiceConfig:
     #: gauges and an ``obs`` block on ``/stats``. Off (default) keeps the
     #: legacy ``/stats`` field set.
     obs_metrics: bool = False
+    #: KV-capacity observability (ISSUE 15): scorer-side block-lifecycle
+    #: ledger fed from the KV-event stream the pool already decodes
+    #: (``BlockStored``/``BlockRemoved`` with their medium — no new wire
+    #: fields), surfaced at ``/debug/lifecycle``, a ``lifecycle`` /stats
+    #: block, and the ``kvcache_block_tier_*`` metric families. Off
+    #: (default) = no ledger attached, bit-identical responses/``/stats``.
+    obs_lifecycle: bool = False
+    #: lifecycle-ledger ring depth for /debug/lifecycle
+    obs_lifecycle_ring: int = 4096
     #: sharded control plane (PR 11): partition the block index by chain
     #: hash across this many scorer shards — per-shard event-apply workers
     #: (no cross-shard lock on ingest) and score reads fanned out across
@@ -159,6 +168,9 @@ class ServiceConfig:
             obs_audit_ring=int(env.get("OBS_AUDIT_RING", "2048")),
             obs_metrics=env.get("OBS_METRICS", "").strip().lower()
             in ("1", "true", "yes", "on"),
+            obs_lifecycle=env.get("OBS_LIFECYCLE", "").strip().lower()
+            in ("1", "true", "yes", "on"),
+            obs_lifecycle_ring=int(env.get("OBS_LIFECYCLE_RING", "4096")),
             scorer_shards=int(env.get("SCORER_SHARDS", "0")),
             scorer_shard_vnodes=int(env.get("SCORER_SHARD_VNODES", "64")),
             route_predict=env.get("ROUTE_PREDICT", "").strip().lower()
@@ -293,6 +305,25 @@ class ScoringService:
                 self.staleness = StalenessTracker()
         else:
             self.staleness = None
+        #: scorer-side block-lifecycle ledger (OBS_LIFECYCLE): fed from
+        #: the event stream (the pool's BlockStored/BlockRemoved feed),
+        #: metric callbacks into the global collector registry. None
+        #: (default) = the pool runs bit-identical legacy.
+        self.lifecycle = None
+        if cfg.obs_lifecycle:
+            from ..obs.lifecycle import BlockLifecycleLedger
+
+            self.lifecycle = BlockLifecycleLedger(
+                ring=cfg.obs_lifecycle_ring,
+                on_transition=collector.observe_tier_transition,
+                on_residency=collector.observe_tier_residency,
+            )
+            # A TTL-swept pod must leave the ledger too (PodDrained and
+            # resync wipes are fed by the pools; the sweeper bypasses
+            # them and talks straight to the index).
+            self.fleet_health.on_pod_swept = (
+                lambda pod: self.lifecycle.observe_pod_gone(pod, "ttl_swept")
+            )
         #: predicted-TTFT routing (ROUTE_PREDICT): the latency model +
         #: per-pod corrector. None (default) = no predictor, no new body
         #: fields read, bit-identical responses and /stats.
@@ -335,6 +366,7 @@ class ScoringService:
                 health=self.fleet_health,
                 staleness=self._shard_staleness,
                 audit=self.route_auditor,
+                lifecycle=self.lifecycle,
                 instrument=cfg.enable_metrics,
             )
             if isinstance(self.staleness, MergedStaleness):
@@ -349,6 +381,7 @@ class ScoringService:
                 health=self.fleet_health,
                 staleness=self.staleness,
                 audit=self.route_auditor,
+                lifecycle=self.lifecycle,
             )
         self.subscriber = ZMQSubscriber(
             self.events_pool,
@@ -817,6 +850,10 @@ class ScoringService:
             }
         if self.staleness is not None and self.config.obs_audit:
             payload["staleness"] = self.staleness.snapshot()
+        if self.lifecycle is not None:
+            # Gated on OBS_LIFECYCLE: the knobs-off /stats payload keeps
+            # its legacy field set bit-identical.
+            payload["lifecycle"] = self.lifecycle.snapshot()
         if self.route_auditor is not None:
             payload["audit"] = self.route_auditor.snapshot()
         if self.predictor is not None:
@@ -859,6 +896,15 @@ class ScoringService:
         status, payload = debug_audit_payload(self.route_auditor, request.query)
         return web.json_response(payload, status=status)
 
+    async def handle_debug_lifecycle(self, request: web.Request) -> web.Response:
+        """The fleet's block tier story as seen from the event stream:
+        recent per-pod transitions, filterable by ``?chain=``/``?block=``
+        hash; disabled until OBS_LIFECYCLE."""
+        from ..obs.lifecycle import debug_lifecycle_payload
+
+        status, payload = debug_lifecycle_payload(self.lifecycle, request.query)
+        return web.json_response(payload, status=status)
+
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/score_completions", self.handle_score_completions)
@@ -869,6 +915,7 @@ class ScoringService:
         app.router.add_get("/debug/traces", self.handle_debug_traces)
         app.router.add_get("/debug/staleness", self.handle_debug_staleness)
         app.router.add_get("/debug/audit", self.handle_debug_audit)
+        app.router.add_get("/debug/lifecycle", self.handle_debug_lifecycle)
         return app
 
 

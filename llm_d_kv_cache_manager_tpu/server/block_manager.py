@@ -95,6 +95,12 @@ class BlockManager:
         #: immediately). None (default) = plain eviction, bit-identical
         #: legacy behavior.
         self._demote = None
+        #: KV-capacity observability (OBS_LIFECYCLE, obs/lifecycle.py):
+        #: ``_lifecycle`` records each cached block's tier transitions,
+        #: ``_mrc`` samples reuse distances off the allocate-time prefix
+        #: walk. Both None (default) = no extra work on any path.
+        self._lifecycle = None
+        self._mrc = None
         self._host_free: list[int] = list(range(config.host_pages - 1, -1, -1))
         self._host_cached: dict[int, int] = {}  # chain_hash -> host slot
         self._host_info: dict[int, _PageInfo] = {}  # host slot -> metadata
@@ -135,6 +141,20 @@ class BlockManager:
         block or raise."""
         self._demote = demote_fn
 
+    def attach_lifecycle(self, ledger=None, mrc=None) -> None:
+        """Attach the ``OBS_LIFECYCLE`` instruments (obs/lifecycle.py):
+        ``ledger`` (a ``BlockLifecycleLedger``) records tier transitions
+        at every allocate/spill/restore/prefetch/demote/import/evict;
+        ``mrc`` (a ``ReuseDistanceEstimator``) observes the full
+        prefix-hash chain of every ``allocate`` lookup. Either may be
+        None; unattached (the default) no path here changes."""
+        self._lifecycle = ledger
+        self._mrc = mrc
+
+    def _record_lifecycle(self, chain_hash, tier: str, reason: str) -> None:
+        if self._lifecycle is not None and chain_hash is not None:
+            self._lifecycle.record(chain_hash, tier, reason)
+
     @property
     def num_host_cached_pages(self) -> int:
         return len(self._host_cached)
@@ -157,6 +177,9 @@ class BlockManager:
             # demote it instead of losing it. The hook snapshots the slot
             # NOW; the caller reuses it immediately after.
             self._demote(info, "host_dram", slot)
+            self._record_lifecycle(info.chain_hash, "remote", "demote")
+        else:
+            self._record_lifecycle(info.chain_hash, "none", "evict")
         self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="host_dram"))
         return slot
 
@@ -186,6 +209,7 @@ class BlockManager:
         self._host_cached[info.chain_hash] = slot
         self._host_info[slot] = info
         self._host_lru[slot] = None
+        self._record_lifecycle(info.chain_hash, "host_dram", "spill")
         self._emit(
             BlockStored(
                 block_hashes=[info.chain_hash],
@@ -231,17 +255,19 @@ class BlockManager:
             assert info.ref_count == 0 and info.chain_hash is not None
             del self._cached[info.chain_hash]
             self._try_offload(page, info)
-            if (
-                self._demote is not None
-                and info.chain_hash not in self._host_cached
-            ):
-                # The host tier didn't keep a copy (absent, full, or the
-                # cost model declined the spill): this recycle destroys
-                # the last local copy — demote over the fabric instead.
-                # The hook queues a snapshot of the page, whose contents
-                # stay intact until the next device dispatch (the same
-                # window the host-tier offload gather relies on).
-                self._demote(info, "tpu_hbm", page)
+            if info.chain_hash not in self._host_cached:
+                if self._demote is not None:
+                    # The host tier didn't keep a copy (absent, full, or
+                    # the cost model declined the spill): this recycle
+                    # destroys the last local copy — demote over the
+                    # fabric instead. The hook queues a snapshot of the
+                    # page, whose contents stay intact until the next
+                    # device dispatch (the same window the host-tier
+                    # offload gather relies on).
+                    self._demote(info, "tpu_hbm", page)
+                    self._record_lifecycle(info.chain_hash, "remote", "demote")
+                else:
+                    self._record_lifecycle(info.chain_hash, "none", "evict")
             self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="tpu_hbm"))
             self._pages[page] = _PageInfo(ref_count=1)
             return page
@@ -266,9 +292,11 @@ class BlockManager:
                 del self._pages[page]
                 self._free.append(page)
 
-    def _try_restore(self, h: int) -> Optional[int]:
+    def _try_restore(self, h: int, reason: str = "restore") -> Optional[int]:
         """Swap a host-DRAM-cached block back into an HBM page (prefix hit
-        on the offload tier). Returns the device page, or None."""
+        on the offload tier). Returns the device page, or None.
+        ``reason`` labels the lifecycle record: "restore" (blocking, from
+        allocate) or "prefetch" (ahead of the scheduler)."""
         slot = self._host_cached.get(h)
         if slot is None or self._copy_in is None:
             return None
@@ -295,6 +323,7 @@ class BlockManager:
         self._pages[page] = info
         self._cached[h] = page
         self._evictable[page] = None  # ref 0 until the caller increfs
+        self._record_lifecycle(h, "tpu_hbm", reason)
         self._emit(BlockRemoved(block_hashes=[h], medium="host_dram"))
         self._emit(
             BlockStored(
@@ -340,7 +369,7 @@ class BlockManager:
                 if not self._restore_policy(run):
                     break
                 restore_until = i + run - 1
-            if self._try_restore(h) is None:
+            if self._try_restore(h, reason="prefetch") is None:
                 break  # no HBM page available: stop, allocate will block
             restored += 1
         if restored:
@@ -428,6 +457,7 @@ class BlockManager:
         self._cached[h] = page
         self._evictable[page] = None
         self._evictable.move_to_end(page)
+        self._record_lifecycle(h, "tpu_hbm", "import")
         self._emit(
             BlockStored(
                 block_hashes=[h],
@@ -448,6 +478,16 @@ class BlockManager:
         tokens = seq.prompt_tokens
         ps = self.config.page_size
         hashes = self.token_db.prefix_hashes(tokens)
+        if self._mrc is not None and not seq.mrc_observed:
+            # The MRC's access stream: every full block this lookup walks
+            # — hits AND misses (the misses register below and become
+            # future reuse), in chain order. Once per REQUEST, not per
+            # allocate call: rollback retries and preemption re-prefills
+            # re-walk the same chain, and double-observing it would feed
+            # tiny artificial reuse distances (the hit_stats
+            # first-prefill-only rule, applied to the curve).
+            seq.mrc_observed = True
+            self._mrc.observe_chain(hashes)
 
         block_table: list[int] = []
         cached_tokens = 0
@@ -567,6 +607,7 @@ class BlockManager:
                 info.token_ids = block
                 info.parent_hash = parent if i > 0 else None
                 self._cached[h] = page
+                self._record_lifecycle(h, "tpu_hbm", "allocate")
                 self._emit(
                     BlockStored(
                         block_hashes=[h],

@@ -500,7 +500,10 @@ class Engine:
         #: device_get the fused fast path overlaps; a slice of the
         #: prefill/decode phases, broken out so fusion is visible),
         #: gather (host<->device page moves, overlaps prefill/decode),
-        #: publish (finish detection + KV-event flush). Off by default:
+        #: demote (remote-tier demotion payload builds — quantize +
+        #: serialize, folded into the flush gather since PR 12 but its
+        #: own label so REMOTE_TIER cost is visible), publish (finish
+        #: detection + KV-event flush). Off by default:
         #: ``obs_step_timing=False`` skips every clock read, so the legacy
         #: step path is untouched.
         self.obs_step_timing = False
@@ -511,6 +514,7 @@ class Engine:
             "decode_s": 0.0,
             "sample_s": 0.0,
             "gather_s": 0.0,
+            "demote_s": 0.0,
             "publish_s": 0.0,
         }
         #: in-flight fused decode burst (decode_pipeline): toks device
@@ -841,14 +845,25 @@ class Engine:
                 n / max(time.perf_counter() - t0, 1e-6),
             )
 
+        demote_s = 0.0
         if self._pending_demotions:
+            # Demotion payload builds (quantize + serialize) ride the
+            # flush but are REMOTE_TIER work, not page-move work: timed
+            # under their own `demote` phase label so the tier's cost
+            # never hides inside `gather`.
+            t_dem = time.perf_counter() if self.obs_step_timing else 0.0
             self._build_demotions(page_data)
+            if self.obs_step_timing:
+                demote_s = time.perf_counter() - t_dem
         self._pending_offloads.clear()
         self._pending_restores.clear()
         self._off_by_slot.clear()
         self._restore_by_page.clear()
         if self.obs_step_timing:
-            self.step_stats["gather_s"] += time.perf_counter() - t_flush
+            self.step_stats["demote_s"] += demote_s
+            self.step_stats["gather_s"] += (
+                time.perf_counter() - t_flush - demote_s
+            )
 
     # -- cross-pod KV transfer (kvcache/transfer) ---------------------------
     @property

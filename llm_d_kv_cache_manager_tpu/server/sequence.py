@@ -100,6 +100,13 @@ class Sequence:
     #: overlap decomposition (pull time before this instant was hidden
     #: behind other work; time after it delayed this sequence's prefill).
     import_wanted_time: Optional[float] = None
+    #: OBS_LIFECYCLE reuse-distance MRC: True once this request's prefix
+    #: chain has been observed by the estimator. Allocation rollbacks
+    #: (scheduler budget overflow) and preemption re-prefills call
+    #: ``allocate`` again for the SAME request — re-observing would feed
+    #: tiny artificial reuse distances and bias the curve upward, the
+    #: same reason ``hit_stats`` snapshots only the first prefill.
+    mrc_observed: bool = False
 
     def __post_init__(self):
         if self.user_prompt_len < 0:

@@ -62,6 +62,7 @@ from ..kvcache.transfer import (
     TransferServiceConfig,
 )
 from ..models import LlamaConfig
+from ..obs import lifecycle as lifecycle_mod
 from ..obs.tracing import Tracer, format_traceparent, parse_traceparent
 from ..utils import get_logger, log_context
 from .engine import Engine, EngineConfig
@@ -91,10 +92,13 @@ class _ServingMetrics:
     collector): request/token counters, prefix-cache savings, TTFT histogram.
     Inert when prometheus_client is unavailable."""
 
-    def __init__(self, obs: bool = False):
+    def __init__(self, obs: bool = False, lifecycle: bool = False):
         """``obs``: build the PR-5 latency-decomposition histograms and
-        engine-step telemetry series (``OBS_METRICS``). Off (default)
-        keeps the exposition surface bit-identical to previous rounds."""
+        engine-step telemetry series (``OBS_METRICS``). ``lifecycle``:
+        build the ISSUE 15 block-lifecycle families (tier transitions,
+        per-tier residency, reuse distance — fed by the ``OBS_LIFECYCLE``
+        ledger/estimator). Both off (default) keeps the exposition
+        surface bit-identical to previous rounds."""
         # Measured serving rates (EMAs over request completions), kept
         # OUTSIDE the prometheus guard: admission control derives its
         # Retry-After hint from them, with or without prometheus_client.
@@ -102,6 +106,7 @@ class _ServingMetrics:
         self.token_rate: Optional[float] = None  # generated tokens / s
         self._last_finish: Optional[float] = None
         self._obs = bool(obs)
+        self._lifecycle = bool(lifecycle)
         try:
             import prometheus_client as prom
         except ImportError:  # pragma: no cover
@@ -206,18 +211,30 @@ class _ServingMetrics:
                 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
             )
+            # TTFT/ITL get a denser grid: a full sub-100 ms decade plus
+            # 0.15/0.2 splits of the old 0.1–0.25 gap. The default
+            # buckets aliased the CPU-smoke serving regime — the r12
+            # burst-arm p50 (≈ 0.17 s) and the precise/predicted race it
+            # decided both lived inside ONE 2.5x-wide bucket, so the
+            # quantile estimate moved more with bucket placement than
+            # with routing policy. queue/e2e/pull keep the legacy grid.
+            lat_buckets = (
+                0.001, 0.0025, 0.005, 0.0075, 0.01, 0.015, 0.02, 0.03,
+                0.04, 0.06, 0.08, 0.1, 0.15, 0.2, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0, 60.0,
+            )
             req_labels = ["outcome", "finish"]
             self.req_ttft = prom.Histogram(
                 "kvcache_request_ttft_seconds",
                 "Time to first token, by cache outcome (warm/pull/cold) "
                 "and finish reason",
-                req_labels, registry=self.registry, buckets=slo_buckets,
+                req_labels, registry=self.registry, buckets=lat_buckets,
             )
             self.req_itl = prom.Histogram(
                 "kvcache_request_itl_seconds",
                 "Mean inter-token latency per request "
                 "((finish - first token) / (generated - 1))",
-                req_labels, registry=self.registry, buckets=slo_buckets,
+                req_labels, registry=self.registry, buckets=lat_buckets,
             )
             self.req_queue = prom.Histogram(
                 "kvcache_request_queue_seconds",
@@ -251,8 +268,8 @@ class _ServingMetrics:
             self.engine_phase_s = prom.Counter(
                 "kvcache_engine_step_phase_seconds_total",
                 "Cumulative engine-step wall seconds by phase (schedule/"
-                "prefill/decode/sample/gather/publish; gather and sample "
-                "overlap the dispatch phases)",
+                "prefill/decode/sample/gather/demote/publish; gather, "
+                "sample and demote overlap the dispatch phases)",
                 ["phase"], registry=self.registry,
             )
             self.engine_occupancy = prom.Gauge(
@@ -274,7 +291,7 @@ class _ServingMetrics:
             self._step_seen = dict.fromkeys(
                 (
                     "schedule_s", "prefill_s", "decode_s", "sample_s",
-                    "gather_s", "publish_s",
+                    "gather_s", "demote_s", "publish_s",
                 ),
                 0.0,
             )
@@ -311,6 +328,58 @@ class _ServingMetrics:
                 "rate)",
                 ["objective", "window"], registry=self.registry,
             )
+        # Block-lifecycle families (ISSUE 15, OBS_LIFECYCLE): tier
+        # transitions, per-tier residency, sampled reuse distance. Built
+        # only under the lifecycle knob so the default exposition surface
+        # stays unchanged; fed by the ledger/estimator callbacks.
+        if self._lifecycle:
+            self.block_transitions = prom.Counter(
+                "kvcache_block_tier_transitions_total",
+                "KV-block tier transitions recorded by the lifecycle "
+                "ledger: from/to in {none, tpu_hbm, host_dram, remote}, "
+                "reason = allocate/import/spill/restore/prefetch/demote "
+                "(hand-off to the pusher; corrected by demote_failed on "
+                "drop/failure)/evict",
+                ["from", "to", "reason"], registry=self.registry,
+            )
+            self.block_residency = prom.Histogram(
+                "kvcache_block_tier_residency_seconds",
+                "How long a KV block stayed resident in a tier before "
+                "leaving it (observed at departure)",
+                ["tier"], registry=self.registry,
+                buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                         120.0, 300.0, 600.0, 1800.0, 3600.0),
+            )
+            self.reuse_distance = prom.Histogram(
+                "kvcache_reuse_distance_blocks",
+                "Sampled LRU stack distance of prefix-block lookups, in "
+                "blocks: P[distance < C] is the modeled hit rate of a "
+                "C-block tier (the MRC behind /debug/mrc); cold accesses "
+                "land in +Inf",
+                registry=self.registry,
+                buckets=tuple(
+                    float(b) for b in lifecycle_mod.REUSE_DISTANCE_BUCKETS
+                ),
+            )
+
+    def observe_tier_transition(self, frm: str, to: str, reason: str) -> None:
+        if self._prom is None or not self._lifecycle:
+            return
+        self.block_transitions.labels(frm, to, reason).inc()
+
+    def observe_tier_residency(self, tier: str, seconds: float) -> None:
+        if self._prom is None or not self._lifecycle:
+            return
+        self.block_residency.labels(tier=tier).observe(seconds)
+
+    def observe_reuse_distance(self, distance_blocks: float) -> None:
+        """Cold (inf) distances are clamped to a finite over-the-top
+        value: they belong in the +Inf bucket, not in the _sum series."""
+        if self._prom is None or not self._lifecycle:
+            return
+        self.reuse_distance.observe(
+            min(distance_blocks, lifecycle_mod.COLD_DISTANCE_CLAMP)
+        )
 
     def set_slo_burn(self, objective: str, window: str, rate: float) -> None:
         if self._prom is None or not self._obs:
@@ -642,6 +711,38 @@ class PodServerConfig:
     obs_slo: str = ""
     #: burn-rate windows in seconds, e.g. ``"60,300"`` (unset = 60,300)
     obs_slo_windows: str = ""
+    # -- KV-capacity observability (ISSUE 15; both off by default = -------
+    # -- bit-identical responses, /stats fields, and wire bytes) -----------
+    #: block-lifecycle ledger + reuse-distance MRC: record every cached
+    #: block's tier transitions off the block-manager hooks and sample
+    #: reuse distances off the allocate-time prefix walk. Surfaced at
+    #: ``/debug/lifecycle`` / ``/debug/mrc``, a ``lifecycle`` /stats
+    #: block, and the kvcache_block_tier_*/kvcache_reuse_distance_blocks
+    #: metric families.
+    obs_lifecycle: bool = False
+    #: lifecycle-ledger ring depth (recent transitions kept for
+    #: /debug/lifecycle)
+    obs_lifecycle_ring: int = 4096
+    #: MRC spatial sample rate in (0, 1]: fraction of blocks (by
+    #: deterministic hash) whose reuse distances are tracked
+    obs_mrc_sample: float = 1.0
+    #: distinct sampled blocks the MRC stack tracks (distances beyond
+    #: this read as cold — the curve saturates at this capacity)
+    obs_mrc_tracked: int = 8192
+    #: flight recorder: always-on bounded ring of per-step engine
+    #: telemetry + fleet events, dumped as one causally-ordered timeline
+    #: on a trigger (SLO burn-rate crossing, breaker OPEN, resync).
+    #: Implies engine step timing (the ring needs the phase deltas).
+    obs_flight: bool = False
+    #: flight-recorder ring depth (per ring: steps and events)
+    obs_flight_ring: int = 2048
+    #: directory for triggered timeline dumps; unset = in-memory only
+    #: (``/debug/flight`` still serves the latest timeline)
+    obs_flight_dir: Optional[str] = None
+    #: burn-rate threshold that triggers a flight dump (needs OBS_SLO for
+    #: the recorder; 8.0 ≈ "budget gone in 1/8 of the window" — between
+    #: the classic 14.4x page and 6x ticket multiwindow alert arms)
+    obs_flight_burn: float = 8.0
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -722,6 +823,25 @@ class PodServerConfig:
         cfg.obs_audit = _env_bool("OBS_AUDIT", "0")
         cfg.obs_slo = os.environ.get("OBS_SLO", "")
         cfg.obs_slo_windows = os.environ.get("OBS_SLO_WINDOWS", "")
+        # KV-capacity observability (ISSUE 15; 0/unset = off, legacy).
+        cfg.obs_lifecycle = _env_bool("OBS_LIFECYCLE", "0")
+        cfg.obs_lifecycle_ring = int(
+            os.environ.get("OBS_LIFECYCLE_RING", cfg.obs_lifecycle_ring)
+        )
+        cfg.obs_mrc_sample = float(
+            os.environ.get("OBS_MRC_SAMPLE", cfg.obs_mrc_sample)
+        )
+        cfg.obs_mrc_tracked = int(
+            os.environ.get("OBS_MRC_TRACKED", cfg.obs_mrc_tracked)
+        )
+        cfg.obs_flight = _env_bool("OBS_FLIGHT", "0")
+        cfg.obs_flight_ring = int(
+            os.environ.get("OBS_FLIGHT_RING", cfg.obs_flight_ring)
+        )
+        cfg.obs_flight_dir = os.environ.get("OBS_FLIGHT_DIR") or None
+        cfg.obs_flight_burn = float(
+            os.environ.get("OBS_FLIGHT_BURN", cfg.obs_flight_burn)
+        )
 
         eng = cfg.engine
         eng.block_manager = BlockManagerConfig(
@@ -854,7 +974,10 @@ class PodServer:
         if engine is not None and on_events is not None:
             # Injected engine: attach the publisher to its block manager.
             self.engine.block_manager.on_events = on_events
-        if self.config.obs_metrics:
+        if self.config.obs_metrics or self.config.obs_flight:
+            # The flight recorder's step ring needs the phase deltas, so
+            # OBS_FLIGHT implies engine step timing even without
+            # OBS_METRICS (same clocks, no new series).
             self.engine.obs_step_timing = True
 
         #: staging guard — HTTP threads only touch the staging deque; the
@@ -880,7 +1003,43 @@ class PodServer:
         self._drain_clean: Optional[bool] = None
         self.drains_started = 0  # guarded_by: _mu|_work
         self.drain_forced_requests = 0  # guarded_by: _mu|_work
-        self.metrics = _ServingMetrics(obs=self.config.obs_metrics)
+        self.metrics = _ServingMetrics(
+            obs=self.config.obs_metrics,
+            lifecycle=self.config.obs_lifecycle,
+        )
+        # -- KV-capacity observability (ISSUE 15; off = None, no hooks) ----
+        #: block-lifecycle ledger + reuse-distance MRC (OBS_LIFECYCLE)
+        self.lifecycle = None
+        self.mrc = None
+        if self.config.obs_lifecycle:
+            from ..obs.lifecycle import (
+                BlockLifecycleLedger,
+                ReuseDistanceEstimator,
+            )
+
+            self.lifecycle = BlockLifecycleLedger(
+                ring=self.config.obs_lifecycle_ring,
+                on_transition=self.metrics.observe_tier_transition,
+                on_residency=self.metrics.observe_tier_residency,
+            )
+            self.mrc = ReuseDistanceEstimator(
+                sample_rate=self.config.obs_mrc_sample,
+                max_tracked=self.config.obs_mrc_tracked,
+                on_distance=self.metrics.observe_reuse_distance,
+            )
+            self.engine.block_manager.attach_lifecycle(
+                self.lifecycle, self.mrc
+            )
+        #: anomaly-triggered flight recorder (OBS_FLIGHT)
+        self.flight = None
+        if self.config.obs_flight:
+            from ..obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                ring=self.config.obs_flight_ring,
+                out_dir=self.config.obs_flight_dir,
+                pod=self.config.pod_identifier,
+            )
         self._running = False
         self._failed: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
@@ -942,6 +1101,18 @@ class PodServer:
             self.slo = SLORecorder(
                 parse_slo_spec(self.config.obs_slo),
                 windows_s=parse_windows(self.config.obs_slo_windows),
+                # SLO burn crossing is the flight recorder's primary
+                # trigger (ISSUE 15): every burn ships its own
+                # postmortem. No recorder (OBS_FLIGHT off) = legacy
+                # observe path, no burn checks.
+                on_burn=(
+                    self._on_slo_burn if self.flight is not None else None
+                ),
+                burn_threshold=(
+                    self.config.obs_flight_burn
+                    if self.flight is not None
+                    else 0.0
+                ),
             )
 
         # -- fleet self-healing (heartbeats + periodic resync) --------------
@@ -1043,6 +1214,7 @@ class PodServer:
             self._drain_done.wait()
             return bool(self._drain_clean)
         self.metrics.observe_drain("started")
+        self._flight_event("drain_started")
         log.warning(
             "drain started",
             pod=self.config.pod_identifier,
@@ -1091,6 +1263,7 @@ class PodServer:
         self._drain_clean = clean
         if clean:
             self.metrics.observe_drain("completed")
+        self._flight_event("drain_complete", clean=clean, forced=leftover)
         self._drain_done.set()
         log.warning("drain complete", pod=self.config.pod_identifier, clean=clean)
         return clean
@@ -1343,6 +1516,29 @@ class PodServer:
             span.set_attr("error", seq.error)
         span.end(end_mono=end)
 
+    # -- flight recorder (OBS_FLIGHT) ----------------------------------------
+    def _on_slo_burn(self, objective: str, window: str, rate: float) -> None:
+        """SLORecorder burn-crossing callback: the flight recorder's
+        primary trigger. The burn sample itself rides the timeline, so a
+        dump always contains what tripped it."""
+        flight = self.flight
+        if flight is None:
+            return
+        flight.record_event(
+            "slo_burn", objective=objective, window=window,
+            rate=round(rate, 4),
+        )
+        flight.trigger(
+            "slo_burn", objective=objective, window=window,
+            rate=round(rate, 4),
+        )
+
+    def _flight_event(self, kind: str, **attrs) -> None:
+        """Record a fleet event on the flight ring (noop with the knob
+        off) — breaker transitions, resyncs, drains, sheds/429s."""
+        if self.flight is not None:
+            self.flight.record_event(kind, **attrs)
+
     def _engine_loop(self) -> None:
         try:
             while True:
@@ -1472,6 +1668,18 @@ class PodServer:
                                 else 0.7 * self._loop_lag_s + 0.3 * sample
                             )
                     finished = self.engine.step()
+                    if self.flight is not None:
+                        # Per-step telemetry onto the flight ring: phase
+                        # deltas (engine step timing is forced on by the
+                        # knob) + the occupancy/free-page/loop-lag gauges.
+                        sch_f = self.engine.scheduler
+                        self.flight.record_step(
+                            self.engine.step_stats,
+                            occupancy=len(sch_f.running)
+                            / max(self.config.engine.decode_batch_size, 1),
+                            free_pages=self.engine.block_manager.num_free,
+                            loop_lag_s=self._loop_lag_s,
+                        )
                     lp = self.engine.last_prefetch
                     if lp is not None:
                         # Host-tier bring-back ran ahead of the scheduler
@@ -1611,6 +1819,16 @@ class PodServer:
                 self._publisher.publish([IndexSnapshot(blocks_by_medium=digest)])
                 with self._mu:
                     self.snapshots_published += 1
+                if self.flight is not None:
+                    # A resync is a repair event worth a postmortem: the
+                    # timeline leading up to it explains what the index
+                    # had to be repaired FROM (trigger dumps are
+                    # rate-limited, so a periodic-resync cadence costs
+                    # one file per window, not one per tick).
+                    self.flight.record_event(
+                        "resync", blocks={m: len(h) for m, h in digest.items()}
+                    )
+                    self.flight.trigger("resync")
                 done.set_result(True)
             except Exception:
                 log.exception("index snapshot publish failed")
@@ -1680,7 +1898,26 @@ class PodServer:
         with self._mu:  # races shutdown's running flip
             if not self._running:
                 return None
-        return self._transfer_pool.get(endpoint)
+        client = self._transfer_pool.get(endpoint)
+        if (
+            client is not None
+            and self.flight is not None
+            and client.breaker is not None
+            and client.breaker.on_transition is None
+        ):
+            # Breaker OPEN is a flight trigger (a dead peer explains the
+            # burn that usually follows); transitions also ride the
+            # timeline as fleet events. Wired once per pooled client.
+            def _breaker_cb(state: str, endpoint: str = endpoint) -> None:
+                flight = self.flight
+                if flight is None:
+                    return
+                flight.record_event("breaker", endpoint=endpoint, state=state)
+                if state == "open":
+                    flight.trigger("breaker_open", endpoint=endpoint)
+
+            client.breaker.on_transition = _breaker_cb
+        return client
 
     # -- remote-tier demotion (REMOTE_TIER) ---------------------------------
     def _serve_push(self, source_pod: str, blocks: list) -> tuple[int, int]:
@@ -1702,14 +1939,29 @@ class PodServer:
         that would have happened without the tier, counted so a pusher
         that cannot keep up is visible rather than a memory leak."""
         dropped = 0
+        dropped_hashes = []
         with self._mu:
             self._demote_queue.extend(payloads)
             cap = max(self.config.remote_demote_queue, 1)
             while len(self._demote_queue) > cap:
-                self._demote_queue.popleft()
+                dropped_hashes.append(self._demote_queue.popleft().block_hash)
                 dropped += 1
             if dropped:
                 self.demote_dropped += dropped
+        self._demote_failed_lifecycle(dropped_hashes)
+
+    def _demote_failed_lifecycle(self, hashes) -> None:
+        """Correct the ledger's optimistic ``demote`` records for blocks
+        the pusher dropped or failed: the block-manager hook records the
+        hand-off (the engine cannot know the wire outcome), so every
+        failure path here — the plain eviction PR 12 defines — must end
+        the phantom remote residency. Guarded per block: a block
+        re-registered locally meanwhile keeps its newer residency."""
+        if self.lifecycle is None:
+            return
+        for h in hashes:
+            if h is not None:
+                self.lifecycle.end_if_tier(h, "remote", "demote_failed")
 
     def _demotion_targets(self) -> list[str]:
         """Peers ordered most-headroom-first (unknown counts as open-ended
@@ -1774,9 +2026,17 @@ class PodServer:
                     # Validation rejects / duplicate holds: the remainder
                     # is plainly evicted, same as legacy.
                     self.demote_failed_blocks += len(batch) - accepted
+            if accepted < len(batch):
+                # The ack carries a count, not per-block verdicts; the
+                # store validates in order, so charging the TAIL is the
+                # closest honest attribution for the ledger correction.
+                self._demote_failed_lifecycle(
+                    [b.block_hash for b in batch[accepted:]]
+                )
             return
         with self._mu:
             self.demote_failed_blocks += len(batch)
+        self._demote_failed_lifecycle([b.block_hash for b in batch])
 
     # -- async prefix import (ASYNC_PULL) -----------------------------------
     def _start_async_pull(self, seq: Sequence, source: str, span) -> None:
@@ -2039,6 +2299,7 @@ class PodServer:
         if cfg.admission_max_waiting > 0 and depth >= cfg.admission_max_waiting:
             self.admission_rejected += 1
             self.metrics.observe_rejected(draining=False)
+            self._flight_event("admission_reject", cap="waiting", depth=depth)
             raise AdmissionError(
                 f"overloaded: {depth} requests waiting >= "
                 f"ADMISSION_MAX_WAITING={cfg.admission_max_waiting}",
@@ -2050,6 +2311,9 @@ class PodServer:
         ):
             self.admission_rejected += 1
             self.metrics.observe_rejected(draining=False)
+            self._flight_event(
+                "admission_reject", cap="tokens", queued_tokens=queued_tokens
+            )
             raise AdmissionError(
                 f"overloaded: {queued_tokens} + {n_tokens} queued prompt "
                 f"tokens > ADMISSION_MAX_QUEUED_TOKENS="
@@ -2130,6 +2394,7 @@ class PodServer:
             if self._draining:
                 self.admission_rejected_draining += 1
                 self.metrics.observe_rejected(draining=True)
+                self._flight_event("admission_reject", cap="draining")
                 raise DrainingError(
                     "pod is draining; retry against another pod"
                 )
@@ -2552,6 +2817,17 @@ class PodServer:
             if self.slo is not None:
                 # SLO block only when OBS_SLO configured an objective.
                 payload["slo"] = self.slo.snapshot()
+            if self.config.obs_lifecycle:
+                # Lifecycle block only with the knob on: the knobs-off
+                # /stats payload stays bit-identical.
+                payload["lifecycle"] = {
+                    **self.lifecycle.snapshot(),
+                    "mrc": self.mrc.snapshot(),
+                }
+            if self.config.obs_flight:
+                # Flight block only with the knob on: the knobs-off
+                # /stats payload stays bit-identical.
+                payload["flight"] = self.flight.snapshot()
             return web.json_response(payload)
 
         async def metrics(_request: web.Request) -> web.Response:
@@ -2574,6 +2850,41 @@ class PodServer:
 
             status, payload = debug_traces_payload(self.tracer, request.query)
             return web.json_response(payload, status=status)
+
+        async def debug_lifecycle(request: web.Request) -> web.Response:
+            """Recent block tier transitions from the bounded ledger ring,
+            filterable by ``?chain=`` / ``?block=`` hash. Reports itself
+            disabled until OBS_LIFECYCLE — the endpoint is harmless."""
+            from ..obs.lifecycle import debug_lifecycle_payload
+
+            status, payload = debug_lifecycle_payload(
+                self.lifecycle, request.query
+            )
+            return web.json_response(payload, status=status)
+
+        async def debug_mrc(request: web.Request) -> web.Response:
+            """The sampled miss-ratio-vs-capacity curve plus the ladder's
+            cumulative tier capacities evaluated on it — the tier-sizing
+            answer (docs/operations.md runbook). Disabled-shaped until
+            OBS_LIFECYCLE."""
+            from ..obs.lifecycle import debug_mrc_payload
+
+            bm_cfg = self.config.engine.block_manager
+            caps = {"tpu_hbm": bm_cfg.total_pages - 1}
+            if bm_cfg.host_pages > 0:
+                caps["tpu_hbm+host_dram"] = (
+                    bm_cfg.total_pages - 1 + bm_cfg.host_pages
+                )
+            return web.json_response(
+                debug_mrc_payload(self.mrc, tier_capacities=caps)
+            )
+
+        async def debug_flight(request: web.Request) -> web.Response:
+            """Flight-recorder counters + the latest triggered timeline
+            (causally ordered). Disabled-shaped until OBS_FLIGHT."""
+            from ..obs.flight import debug_flight_payload
+
+            return web.json_response(debug_flight_payload(self.flight))
 
         async def debug_profile(request: web.Request) -> web.Response:
             """Capture a jax.profiler trace of the live engine for
@@ -2643,6 +2954,9 @@ class PodServer:
         app.router.add_get("/stats", stats)
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/debug/traces", debug_traces)
+        app.router.add_get("/debug/lifecycle", debug_lifecycle)
+        app.router.add_get("/debug/mrc", debug_mrc)
+        app.router.add_get("/debug/flight", debug_flight)
         app.router.add_post("/debug/profile", debug_profile)
         return app
 

@@ -166,6 +166,38 @@ class TestTracer:
         (trace,) = t.traces(request_id="rb")
         assert trace["trace_id"] == b.context.trace_id
 
+    def test_span_name_filter(self):
+        """ISSUE 15 satellite: ``span=`` keeps traces CONTAINING a span
+        of that name (whole trace returned — the match stays readable in
+        context), composing with the id filters."""
+        from llm_d_kv_cache_manager_tpu.obs.tracing import (
+            debug_traces_payload,
+        )
+
+        t = Tracer(enabled=True)
+        root = t.start_span("disagg.request")
+        t.start_span("disagg.handoff", parent=root).end()
+        root.end()
+        t.start_span("pod.request").end()  # no handoff span
+        (trace,) = t.traces(span_name="disagg.handoff")
+        assert trace["trace_id"] == root.context.trace_id
+        assert {s["name"] for s in trace["spans"]} == {
+            "disagg.request", "disagg.handoff"
+        }
+        assert t.traces(span_name="nope") == []
+        # The shared /debug/traces contract reads the `span` query key.
+        status, payload = debug_traces_payload(
+            t, {"span": "disagg.handoff"}
+        )
+        assert status == 200 and len(payload["traces"]) == 1
+        # Composes with trace_id: both filters must match.
+        assert (
+            t.traces(
+                trace_id=root.context.trace_id, span_name="pod.request"
+            )
+            == []
+        )
+
     def test_record_span_backdates(self):
         t = Tracer(enabled=True)
         now = time.monotonic()
@@ -344,7 +376,7 @@ class TestLatencyDecomposition:
         m = _ServingMetrics(obs=True)
         stats = {"steps": 2, "schedule_s": 0.5, "prefill_s": 1.0,
                  "decode_s": 0.25, "sample_s": 0.0625, "gather_s": 0.0,
-                 "publish_s": 0.125}
+                 "demote_s": 0.03125, "publish_s": 0.125}
         m.sync_step_stats(stats, lag_s=0.01)
         m.sync_step_stats(stats, lag_s=0.01)  # no double count
         text = m.exposition().decode()
@@ -356,7 +388,37 @@ class TestLatencyDecomposition:
             'kvcache_engine_step_phase_seconds_total{phase="sample"} 0.0625'
             in text
         )
+        # Remote-tier demotion payload builds are their own phase (ISSUE
+        # 15 satellite): PR 12 folded them into the flush gather, where
+        # the tier's quantize+serialize cost hid untagged.
+        assert (
+            'kvcache_engine_step_phase_seconds_total{phase="demote"} 0.03125'
+            in text
+        )
         assert "kvcache_engine_loop_lag_seconds 0.01" in text
+
+    def test_engine_demote_phase_key_present(self):
+        # The engine's step_stats dict itself carries the label's feed.
+        from llm_d_kv_cache_manager_tpu.server.engine import Engine
+
+        eng = Engine(_engine_config())
+        assert "demote_s" in eng.step_stats
+
+    def test_ttft_itl_buckets_cover_sub_100ms_decade(self):
+        """ISSUE 15 satellite: the TTFT/ITL histograms carry a full
+        sub-100 ms decade plus the 0.15/0.2 splits of the old 0.1–0.25
+        gap (the r12 CPU-smoke p50 ≈ 0.17 s lived inside one 2.5x-wide
+        bucket). queue/e2e keep the legacy SLO grid."""
+        pytest.importorskip("prometheus_client")
+        m = _ServingMetrics(obs=True)
+        m.observe_finished(self._finished_seq())
+        text = m.exposition().decode()
+        for le in ("0.0075", "0.015", "0.02", "0.03", "0.04", "0.06",
+                   "0.08", "0.15", "0.2"):
+            assert f'kvcache_request_ttft_seconds_bucket{{finish="length",le="{le}"' in text, le
+            assert f'kvcache_request_itl_seconds_bucket{{finish="length",le="{le}"' in text, le
+            # The legacy grid on queue/e2e is untouched (no new bounds).
+            assert f'kvcache_request_queue_seconds_bucket{{finish="length",le="{le}"' not in text, le
 
     def test_pull_overlap_histogram_kinds(self):
         pytest.importorskip("prometheus_client")
