@@ -124,6 +124,15 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
   BENCH_REMOTE_STORE_PAGES=N  kvstore holder capacity in pages (default =
                        4x the arm's per-pod pool, so the fleet working
                        set survives demotion)
+  BENCH_KV_QUANT_HBM=1 quantized-HBM arm (ISSUE 16): re-run `precise`
+                       under the pressure pool's HBM BYTE budget with
+                       KV_QUANT_HBM=int8 — int8 pages halve bytes/page,
+                       so the same bytes hold 2x the pages. The summary's
+                       `kv_quant_hbm` block closes the pre-registration
+                       loop (bare arm's MRC forecast at the 2x capacity
+                       point vs this arm's measured hit, within 0.05) and
+                       carries the tok/s/chip A/B plus the decode/sample
+                       phase deltas when BENCH_STEP_PHASES=1
   BENCH_REPEATS=N      re-run the pressure arms N times and report MEDIAN
                        hit-rate fields (hit_{arm}) + the estimated/precise
                        p90 race median with spread — single noisy rounds
@@ -1028,6 +1037,12 @@ def run_policy(
     if mrc_est is not None:
         total_cap = engine_cfg.block_manager.total_pages - 1
         caps = {"hbm": total_cap}
+        # KV_QUANT_HBM sizing point (ISSUE 16): int8 HBM pages halve the
+        # bytes per page, so the same HBM byte budget holds 2x the pages
+        # (minus the reserved page 0). Read on the UNQUANTIZED arm, this
+        # is the pre-registered forecast the quantized arm must then
+        # measure within 0.05 — the "2x point" of the MRC sizing runbook.
+        caps["hbm_2x"] = 2 * engine_cfg.block_manager.total_pages - 1
         if engine_cfg.block_manager.host_pages > 0:
             caps["hbm_host"] = total_cap + engine_cfg.block_manager.host_pages
         if remote and store is not None:
@@ -1674,6 +1689,26 @@ def main() -> int:
                 remote_tier=True,
             )
             pressure_arms["precise_remote"] = ("precise", remote_cfg, True)
+        # Quantized-HBM arm (ISSUE 16): precise routing under the SAME
+        # HBM byte budget as the bare pressure pool, but KV_QUANT_HBM=int8
+        # halves the bytes per page, so those bytes hold 2x the pages.
+        # The unquantized arm's MRC forecast at the 2x capacity point
+        # (mrc_predicted_hit_2x, pre-registered in BENCH_r14.json before
+        # the kernel landed) is the number this arm's measured hit must
+        # land within 0.05 of.
+        if (
+            "precise" in policies
+            and os.environ.get("BENCH_KV_QUANT_HBM", "0") == "1"
+        ):
+            hbm_q8_cfg = dataclasses.replace(
+                pressure_cfg,
+                block_manager=dataclasses.replace(
+                    pressure_cfg.block_manager,
+                    total_pages=2 * pressure_pages,
+                ),
+                kv_quant_hbm="int8",
+            )
+            pressure_arms["precise_hbm_q8"] = ("precise", hbm_q8_cfg, False)
         for name, (policy, cfg_, rmt) in pressure_arms.items():
             # MRC estimators ride every pressure arm (ISSUE 15): the
             # forced-eviction regime is where predicted-vs-measured
@@ -2120,6 +2155,50 @@ def main() -> int:
                 pressure["p50_remote_over_unpressured_precise"] = round(
                     prm["p50_ttft_s"] / precise["p50_ttft_s"], 3
                 )
+        pq = pressure_results.get("precise_hbm_q8")
+        if pq is not None and pp is not None:
+            # The quantized-HBM headline (ISSUE 16): same HBM bytes, 2x
+            # the pages. Forecast-vs-measured closes the pre-registration
+            # loop (the predicted number was recorded from the bare arm's
+            # curve BEFORE the kernel landed); the throughput A/B and the
+            # per-phase deltas show what in-kernel dequant costs (or
+            # saves — decode is DMA-bound) on the same workload.
+            preds_2x = pressure_mrc.get("precise", {}).get("hbm_2x") or []
+            measured = pressure.get("hit_precise_hbm_q8")
+            hbm_q8 = {
+                "kv_quant_hbm": "int8",
+                "total_pages_2x": 2 * pressure_pages,
+                "measured_hit": measured,
+            }
+            if preds_2x and measured is not None:
+                predicted = round(statistics.median(preds_2x), 4)
+                hbm_q8["mrc_predicted_hit_2x"] = predicted
+                hbm_q8["abs_error"] = round(abs(predicted - measured), 4)
+                hbm_q8["ok"] = bool(abs(predicted - measured) <= 0.05)
+            if pp["output_tok_s_per_chip"] > 0:
+                hbm_q8["tok_s_per_chip"] = {
+                    "precise": round(pp["output_tok_s_per_chip"], 3),
+                    "precise_hbm_q8": round(pq["output_tok_s_per_chip"], 3),
+                    "ratio": round(
+                        pq["output_tok_s_per_chip"]
+                        / pp["output_tok_s_per_chip"],
+                        3,
+                    ),
+                }
+            if "phases" in pp and "phases" in pq:
+                hbm_q8["phase_deltas"] = {
+                    key: {
+                        "precise_s": pp["phases"].get(key, 0),
+                        "precise_hbm_q8_s": pq["phases"].get(key, 0),
+                        "delta_s": round(
+                            pq["phases"].get(key, 0)
+                            - pp["phases"].get(key, 0),
+                            4,
+                        ),
+                    }
+                    for key in ("decode_s", "sample_s")
+                }
+            pressure["kv_quant_hbm"] = hbm_q8
 
     # Workload-family headline (ISSUE 14): per-arm p50/p99 TTFT for the
     # three policies, the burst+ramp acceptance verdicts (predicted must

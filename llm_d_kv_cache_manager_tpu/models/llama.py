@@ -51,7 +51,7 @@ from .quant import QuantizedTensor, materialize as _w
 
 def _paged_attention_tp(
     q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v, *, interpret, mesh,
-    layer: int = 0
+    layer: int = 0, k_scale=None, v_scale=None,
 ):
     """Decode attention, head-parallel over the ``tp`` mesh axis.
 
@@ -71,6 +71,7 @@ def _paged_attention_tp(
     if mesh is None:
         return paged_attention(
             q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v,
+            k_scale=k_scale, v_scale=v_scale,
             interpret=interpret, layer=layer,
         )
     from jax.sharding import PartitionSpec as P
@@ -80,16 +81,38 @@ def _paged_attention_tp(
     kv_spec = (
         P(None, None, None, "tp") if kp.ndim == 5 else P(None, None, "tp")
     )
+    in_specs = [
+        P(None, "tp"), kv_spec, kv_spec, P(), P(),
+        P(None, "tp"), P(None, "tp"),
+    ]
+    args = [q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v]
+    if k_scale is not None:
+        # Scale pools [L, P, n_kv] shard like the page pools: kv-head axis
+        # over tp, so each shard dequantizes its own heads' codes locally.
+        scale_spec = (
+            P(None, None, "tp") if k_scale.ndim == 3 else P(None, "tp")
+        )
+
+        def call(q, kp, vp, bt, sl, fk, fv, ks, vs):
+            return paged_attention(
+                q, kp, vp, bt, sl, fk, fv, k_scale=ks, v_scale=vs,
+                interpret=interpret, layer=layer,
+            )
+
+        fn = shard_map_compat(
+            call,
+            mesh=mesh,
+            in_specs=tuple(in_specs + [scale_spec, scale_spec]),
+            out_specs=P(None, "tp"),
+        )
+        return fn(*args, k_scale, v_scale)
     fn = shard_map_compat(
         functools.partial(paged_attention, interpret=interpret, layer=layer),
         mesh=mesh,
-        in_specs=(
-            P(None, "tp"), kv_spec, kv_spec, P(), P(),
-            P(None, "tp"), P(None, "tp"),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, "tp"),
     )
-    return fn(q, kp, vp, block_tables, seq_lens, fresh_k, fresh_v)
+    return fn(*args)
 
 def _sp_prefill_attention(
     q, k, v, k_pages_l, v_pages_l, block_tables, ctx_lens, positions, valid, mesh
@@ -526,11 +549,32 @@ def init_params(
     return params
 
 
-def init_kv_pages(cfg: LlamaConfig, total_pages: int, page_size: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+def init_kv_pages(
+    cfg: LlamaConfig,
+    total_pages: int,
+    page_size: int,
+    kv_quant_hbm: Optional[str] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Zeroed K and V page pools:
-    ``[n_layers, total_pages, page_size, n_kv_heads, head_dim]``."""
+    ``[n_layers, total_pages, page_size, n_kv_heads, head_dim]``.
+
+    With ``kv_quant_hbm="int8"`` the pools hold int8 codes (half the HBM
+    bytes per page — 2× pages per chip at the same budget); the matching
+    per-page scale pools come from :func:`init_kv_scales`."""
     shape = (cfg.n_layers, total_pages, page_size, cfg.n_kv_heads, cfg.hd)
-    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+    dtype = jnp.int8 if kv_quant_hbm == "int8" else cfg.dtype
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_kv_scales(
+    cfg: LlamaConfig, total_pages: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Zeroed per-page-per-(layer, kv_head) f32 scale pools
+    ``[n_layers, total_pages, n_kv_heads]`` for an int8 HBM KV pool
+    (``KV_QUANT_HBM=int8``). Zero scales dequantize to exact zeros, so a
+    fresh quantized pool reads identically to the legacy zeroed bf16 pool."""
+    shape = (cfg.n_layers, total_pages, cfg.n_kv_heads)
+    return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
 
 
 def _qkv(layer: Params, cfg: LlamaConfig, x: jnp.ndarray):
@@ -828,6 +872,70 @@ def _scatter_kv_pages_all_layers(
     return pages.at[:, pidx, sidx].set(updates, mode="drop")
 
 
+def _quantized_scatter_kv_all_layers(
+    pages_q: jnp.ndarray,  # [n_layers, total_pages, page_size, n_kv, hd] int8
+    scales: jnp.ndarray,  # [n_layers, total_pages, n_kv] f32
+    fresh: jnp.ndarray,  # [n_layers, b, s, n_kv, hd]
+    page_ids: jnp.ndarray,  # [b, s]
+    slot_ids: jnp.ndarray,  # [b, s]
+    valid: jnp.ndarray,  # [b, s]
+    positions: jnp.ndarray,  # [b, s] absolute positions of the written tokens
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write-time quantization (``KV_QUANT_HBM=int8``): the int8 analogue of
+    :func:`_scatter_kv_pages_all_layers`, maintaining the per-page-per-
+    (layer, kv_head) symmetric scales as it writes.
+
+    Engine contracts this leans on: chunk positions are consecutive, the
+    valid mask is a right-padded prefix, and no page is shared between rows.
+    So the only page that can already hold live codes is each row's FIRST
+    page, and only when the row's first position is not page-aligned (the
+    "carry" page — in practice the decode write at ``my_slot != 0``; engine
+    prefill chunks start page-aligned). Every other written page is fresh:
+    its scale resets to zero before the scatter-max, so a previous tenant's
+    scale can never inflate the new resolution. The carry page's resident
+    codes are requantized under the grown scale with the exact ratio
+    ``s_old / s_new`` — a bit-exact no-op when the scale is unchanged."""
+    L, P, ps, n_kv, hd = pages_q.shape
+    b, s = page_ids.shape
+    pidx = jnp.where(valid.reshape(-1), page_ids.reshape(-1), P)
+    sidx = slot_ids.reshape(-1)
+    x = fresh.reshape(L, b * s, n_kv, hd).astype(jnp.float32)
+
+    row_valid = valid[:, 0]
+    carry = (positions[:, 0] % ps) != 0
+    carry_page = jnp.where(row_valid & carry, page_ids[:, 0], P)  # [b]
+
+    # Fresh pages (everything written except each row's carry page): zero
+    # their scales so the scatter-max below starts from a clean slate.
+    fresh_page_mask = valid & (page_ids != carry_page[:, None])
+    fresh_pidx = jnp.where(fresh_page_mask.reshape(-1), page_ids.reshape(-1), P)
+    scales0 = scales.at[:, fresh_pidx].set(0.0, mode="drop")
+
+    # Per-token symmetric scale candidates, scatter-maxed into the pages
+    # (same floor/denominator as quant.quantize_kv_page).
+    cand = jnp.maximum(jnp.max(jnp.abs(x), axis=-1), 1e-8) / 127.0  # [L, N, n_kv]
+    new_scales = scales0.at[:, pidx].max(cand, mode="drop")
+
+    # Requantize the carry page's resident codes under the grown scale.
+    cp = jnp.minimum(carry_page, P - 1)  # clamped for the gather only
+    old = pages_q[:, cp].astype(jnp.float32)  # [L, b, ps, n_kv, hd]
+    s_old = scales[:, cp]  # [L, b, n_kv] — pre-update scales
+    s_new = new_scales[:, cp]
+    ratio = jnp.where(s_new > 0, s_old / jnp.maximum(s_new, 1e-30), 1.0)
+    req = jnp.clip(
+        jnp.round(old * ratio[:, :, None, :, None]), -127, 127
+    ).astype(jnp.int8)
+    pages_q = pages_q.at[:, carry_page].set(req, mode="drop")
+
+    # Quantize the fresh tokens with their page's final scale and scatter.
+    s_tok = new_scales[:, jnp.minimum(pidx, P - 1)]  # [L, N, n_kv]
+    q = jnp.clip(
+        jnp.round(x / jnp.maximum(s_tok, 1e-30)[..., None]), -127, 127
+    ).astype(jnp.int8)
+    pages_q = pages_q.at[:, pidx, sidx].set(q, mode="drop")
+    return pages_q, new_scales
+
+
 def _prefill_body(
     params: Params,
     cfg: LlamaConfig,
@@ -842,12 +950,18 @@ def _prefill_body(
     ctx_lens: jnp.ndarray,  # [b]
     mesh,
     attn_impl: str,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scales=None,  # [L, P, n_kv] f32 when KV_QUANT_HBM=int8
+    v_scales=None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, Any, Any]:
     """Traced prefill layer loop shared by ``prefill`` and the fused
     speculative-decode scan (``spec_decode_steps``): chunk forward with
     paged-context attention + one batched KV scatter. Returns (hidden
-    states [b, s, d], k_pages, v_pages); logits selection stays with the
-    caller."""
+    states [b, s, d], k_pages, v_pages, k_scales, v_scales); logits
+    selection stays with the caller. Scales are None (and pass through
+    untouched) unless the pools are int8 (``KV_QUANT_HBM``), in which
+    case the scatter quantizes at write time and the paged-context gather
+    dequantizes chunk-locally — the engine restricts the quantized path
+    to the ``xla`` single-shard prefill."""
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     h = _embed(params, cfg, tokens)  # [b, s, d]
@@ -882,6 +996,8 @@ def _prefill_body(
             attn = prefill_with_paged_context(
                 q, k, v, k_pages[li], v_pages[li], block_tables, ctx_lens,
                 positions=positions, valid=valid,
+                k_scales=None if k_scales is None else k_scales[li],
+                v_scales=None if v_scales is None else v_scales[li],
             )
         b, s, _, _ = attn.shape
         h = h + attn.reshape(b, s, -1) @ _w(layer["wo"], h.dtype)
@@ -896,19 +1012,31 @@ def _prefill_body(
     # attention never reads these pages (fresh K/V ride function arguments),
     # so deferring the writes is exact — and a single aliased update avoids
     # the full pool copy a per-layer rebuild costs.
-    k_pages = _scatter_kv_pages_all_layers(
-        k_pages, jnp.stack(fresh_k).astype(k_pages.dtype), page_ids, slot_ids, valid
-    )
-    v_pages = _scatter_kv_pages_all_layers(
-        v_pages, jnp.stack(fresh_v).astype(v_pages.dtype), page_ids, slot_ids, valid
-    )
-    return h, k_pages, v_pages
+    if k_scales is not None:
+        k_pages, k_scales = _quantized_scatter_kv_all_layers(
+            k_pages, k_scales, jnp.stack(fresh_k), page_ids, slot_ids,
+            valid, positions,
+        )
+        v_pages, v_scales = _quantized_scatter_kv_all_layers(
+            v_pages, v_scales, jnp.stack(fresh_v), page_ids, slot_ids,
+            valid, positions,
+        )
+    else:
+        k_pages = _scatter_kv_pages_all_layers(
+            k_pages, jnp.stack(fresh_k).astype(k_pages.dtype), page_ids,
+            slot_ids, valid
+        )
+        v_pages = _scatter_kv_pages_all_layers(
+            v_pages, jnp.stack(fresh_v).astype(v_pages.dtype), page_ids,
+            slot_ids, valid
+        )
+    return h, k_pages, v_pages, k_scales, v_scales
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "attn_impl", "return_all_logits"),
-    donate_argnames=("k_pages", "v_pages"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"),
 )
 def prefill(
     params: Params,
@@ -925,7 +1053,9 @@ def prefill(
     mesh=None,  # tp mesh for expert-parallel MoE dispatch
     attn_impl: str = "xla",  # "xla" (scan flash) | "pallas" (flash kernel)
     return_all_logits: bool = False,  # [b, s, vocab] for spec-decode verify
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scales=None,  # [L, P, n_kv] f32 — int8 pools (KV_QUANT_HBM)
+    v_scales=None,
+) -> tuple[jnp.ndarray, ...]:
     """Process a prompt chunk: returns (logits at last valid position per
     sequence [b, vocab], updated k_pages, v_pages).
 
@@ -958,21 +1088,32 @@ def prefill(
         jax.debug.callback(
             _check_right_padded_mask, jnp.all(contract == valid)
         )
-    h, k_pages, v_pages = _prefill_body(
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
+    quantized = k_scales is not None
+    if quantized and (sp > 1 or attn_impl == "pallas"):
+        raise ValueError(
+            "KV_QUANT_HBM prefill requires the xla single-shard path"
+        )
+    h, k_pages, v_pages, k_scales, v_scales = _prefill_body(
         params, cfg, tokens, positions, valid, k_pages, v_pages,
         page_ids, slot_ids, block_tables, ctx_lens, mesh, attn_impl,
+        k_scales, v_scales,
     )
 
+    # Knob-off callers keep the legacy 3-tuple; quantized callers get the
+    # updated scale pools appended.
+    extra = (k_scales, v_scales) if quantized else ()
     if return_all_logits:
         # Every chunk position's next-token logits [b, s, vocab] — the
         # speculative-decode verify step scores all k+1 proposed tokens in
         # this one dispatch (chunks there are tiny, so the full-position
         # lm_head stays cheap).
-        return _logits(params, cfg, h), k_pages, v_pages
+        return (_logits(params, cfg, h), k_pages, v_pages) + extra
     # Logits at each sequence's last valid position.
     last_idx = jnp.maximum(jnp.sum(valid.astype(jnp.int32), axis=1) - 1, 0)  # [b]
     h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [b, d]
-    return _logits(params, cfg, h_last[:, None, :])[:, 0], k_pages, v_pages
+    return (_logits(params, cfg, h_last[:, None, :])[:, 0], k_pages, v_pages) + extra
 
 
 def _decode_body(
@@ -987,11 +1128,14 @@ def _decode_body(
     page_size: int,
     interpret: bool,
     mesh=None,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scales=None,  # [L, P, n_kv] f32 when KV_QUANT_HBM=int8
+    v_scales=None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, Any, Any]:
     """Single decode step (traced body shared by ``decode_step`` and the
     fused ``decode_steps`` scan). Writes this token's K/V into its page
     slot, runs paged attention over the full context, returns
-    (logits [b, vocab], k_pages, v_pages)."""
+    (logits [b, vocab], k_pages, v_pages, k_scales, v_scales) — scales are
+    None pass-throughs unless the pools are int8 (``KV_QUANT_HBM``)."""
     inv_freq = jnp.asarray(rope_frequencies(cfg.hd, cfg.rope_theta, cfg.rope_scaling))
     b = tokens.shape[0]
     h = _embed(params, cfg, tokens)[:, None, :]  # [b, 1, d]
@@ -1025,6 +1169,8 @@ def _decode_body(
             interpret=interpret,
             mesh=mesh,
             layer=li,
+            k_scale=k_scales,
+            v_scale=v_scales,
         )  # [b, n_heads, hd]
         h = h + (attn.reshape(b, -1) @ _w(layer["wo"], h.dtype))[:, None, :]
 
@@ -1034,25 +1180,37 @@ def _decode_body(
         fresh_k.append(k)
         fresh_v.append(v)
 
-    k_pages = _scatter_kv_pages_all_layers(
-        k_pages, jnp.stack(fresh_k).astype(k_pages.dtype),
-        my_page[:, None], my_slot[:, None], valid,
-    )
-    v_pages = _scatter_kv_pages_all_layers(
-        v_pages, jnp.stack(fresh_v).astype(v_pages.dtype),
-        my_page[:, None], my_slot[:, None], valid,
-    )
+    if k_scales is not None:
+        k_pages, k_scales = _quantized_scatter_kv_all_layers(
+            k_pages, k_scales, jnp.stack(fresh_k),
+            my_page[:, None], my_slot[:, None], valid, positions[:, None],
+        )
+        v_pages, v_scales = _quantized_scatter_kv_all_layers(
+            v_pages, v_scales, jnp.stack(fresh_v),
+            my_page[:, None], my_slot[:, None], valid, positions[:, None],
+        )
+    else:
+        k_pages = _scatter_kv_pages_all_layers(
+            k_pages, jnp.stack(fresh_k).astype(k_pages.dtype),
+            my_page[:, None], my_slot[:, None], valid,
+        )
+        v_pages = _scatter_kv_pages_all_layers(
+            v_pages, jnp.stack(fresh_v).astype(v_pages.dtype),
+            my_page[:, None], my_slot[:, None], valid,
+        )
     return (
         _logits(params, cfg, h)[:, 0],
         k_pages,
         v_pages,
+        k_scales,
+        v_scales,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "page_size", "interpret", "mesh"),
-    donate_argnames=("k_pages", "v_pages"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"),
 )
 def decode_step(
     params: Params,
@@ -1067,18 +1225,26 @@ def decode_step(
     page_size: int,
     interpret: bool = False,
     mesh=None,  # tp mesh for head-parallel decode attention
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One decode step; sampling stays with the caller (host or jit)."""
-    return _decode_body(
+    k_scales=None,  # [L, P, n_kv] f32 — int8 pools (KV_QUANT_HBM)
+    v_scales=None,
+) -> tuple[jnp.ndarray, ...]:
+    """One decode step; sampling stays with the caller (host or jit).
+    Returns the legacy 3-tuple, with updated scale pools appended when
+    the pools are quantized."""
+    logits, k_pages, v_pages, k_scales, v_scales = _decode_body(
         params, cfg, tokens, positions, k_pages, v_pages,
         block_tables, seq_lens, page_size, interpret, mesh,
+        k_scales, v_scales,
     )
+    if k_scales is None:
+        return logits, k_pages, v_pages
+    return logits, k_pages, v_pages, k_scales, v_scales
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "page_size", "num_steps", "interpret", "mesh"),
-    donate_argnames=("k_pages", "v_pages"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"),
 )
 def decode_steps(
     params: Params,
@@ -1098,7 +1264,9 @@ def decode_steps(
     num_steps: int,
     interpret: bool = False,
     mesh=None,  # tp mesh for head-parallel decode attention
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scales=None,  # [L, P, n_kv] f32 — int8 pools (KV_QUANT_HBM)
+    v_scales=None,
+) -> tuple[jnp.ndarray, ...]:
     """``num_steps`` fused decode iterations with on-device sampling.
 
     The device-resident decode loop: one ``lax.scan`` over single-step
@@ -1113,29 +1281,39 @@ def decode_steps(
     """
     from ..ops.sampling import sample_tokens
 
+    quantized = k_scales is not None
+
     def body(carry, key):
-        tokens, positions, seq_lens, k_pages, v_pages = carry
-        logits, k_pages, v_pages = _decode_body(
+        tokens, positions, seq_lens, k_pages, v_pages, k_sc, v_sc = carry
+        logits, k_pages, v_pages, k_sc, v_sc = _decode_body(
             params, cfg, tokens, positions, k_pages, v_pages,
             block_tables, seq_lens, page_size, interpret, mesh,
+            k_sc, v_sc,
         )
         nxt = sample_tokens(logits.astype(jnp.float32), temperature, top_k, top_p, key)
-        return (nxt, positions + 1, seq_lens + 1, k_pages, v_pages), nxt
+        return (nxt, positions + 1, seq_lens + 1, k_pages, v_pages, k_sc, v_sc), nxt
 
+    # None scales are valid (empty) scan-carry leaves, so the knob-off
+    # trace is unchanged apart from the tuple arity.
+    carry0 = (tokens, positions, seq_lens, k_pages, v_pages, k_scales, v_scales)
     keys = jax.random.split(rng_key, num_steps)
     if num_steps == 1:
         # The device-resident step-per-token loop (decode_fused_sampling
         # at k=1) lands here every iteration: skip the scan machinery for
         # a plain body call. Consumes keys[0] exactly like the scan's
         # first slice, so sampled streams are bit-identical across paths.
-        (_, _, _, k_pages, v_pages), nxt = body(
-            (tokens, positions, seq_lens, k_pages, v_pages), keys[0]
+        (_, _, _, k_pages, v_pages, k_scales, v_scales), nxt = body(
+            carry0, keys[0]
         )
-        return nxt[:, None], k_pages, v_pages
-    (_, _, _, k_pages, v_pages), toks = jax.lax.scan(
-        body, (tokens, positions, seq_lens, k_pages, v_pages), keys
-    )
-    return toks.T, k_pages, v_pages
+        toks = nxt[:, None]
+    else:
+        (_, _, _, k_pages, v_pages, k_scales, v_scales), toks = jax.lax.scan(
+            body, carry0, keys
+        )
+        toks = toks.T
+    if quantized:
+        return toks, k_pages, v_pages, k_scales, v_scales
+    return toks, k_pages, v_pages
 
 
 @functools.partial(
@@ -1275,7 +1453,8 @@ def spec_decode_steps(
             block_tables, jnp.clip(positions // page_size, 0, P - 1), axis=1
         )
         slot_ids = positions % page_size
-        h, k_pages, v_pages = _prefill_body(
+        # Scales stay None: the engine rejects spec_decode + KV_QUANT_HBM.
+        h, k_pages, v_pages, _, _ = _prefill_body(
             params, cfg, chunk, positions, valid, k_pages, v_pages,
             page_ids, slot_ids, block_tables, start, mesh, attn_impl,
         )

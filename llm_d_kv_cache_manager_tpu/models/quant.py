@@ -134,11 +134,41 @@ def quantize_params(
 #: modes accepted by the ``KV_QUANT`` knob
 KV_QUANT_MODES = ("int8",)
 
+#: modes accepted by the ``KV_QUANT_HBM`` knob (ISSUE 16). ``float8_e4m3``
+#: is the declared follow-on storage mode: recognized here so the knob
+#: surface is stable, but rejected with NotImplementedError at engine
+#: init until the kernel grows an fp8 dequant path.
+KV_QUANT_HBM_MODES = ("int8", "float8_e4m3")
+
 
 def kv_scale_shape(page_shape: tuple[int, ...]) -> tuple[int, ...]:
     """Scale array shape for one quantized KV page slice."""
     n_layers, _, n_kv_heads, _ = page_shape
     return (n_layers, 1, n_kv_heads, 1)
+
+
+def kv_hbm_scale_shape(pool_shape: tuple[int, ...]) -> tuple[int, ...]:
+    """Scale pool shape for an int8 HBM KV pool
+    ``[n_layers, total_pages, page_size, n_kv_heads, head_dim]`` →
+    ``[n_layers, total_pages, n_kv_heads]``. One f32 scale per page per
+    (layer, kv_head) — the SAME granularity as the host tier's
+    :func:`kv_scale_shape`, so a page's codes and scales copy between
+    tiers (and onto the PR 6 wire triple) with a reshape, never a
+    dequant→requant round trip."""
+    n_layers, total_pages, _, n_kv_heads, _ = pool_shape
+    return (n_layers, total_pages, n_kv_heads)
+
+
+def dequantize_kv_pool(
+    q: np.ndarray, scales: np.ndarray, dtype: Any
+) -> np.ndarray:
+    """Full-width view of an int8 HBM pool ``[..., P, ps, n_kv, hd]`` with
+    per-page scales ``[..., P, n_kv]`` — the tests' / oracle's view; the
+    serving path never materializes this (the kernel dequantizes
+    in-register)."""
+    q32 = np.asarray(q, np.float32)
+    s = np.asarray(scales, np.float32)[..., None, :, None]
+    return (q32 * s).astype(dtype)
 
 
 def quantize_kv_page(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -155,6 +155,8 @@ def prefill_with_paged_context(
     positions: jnp.ndarray,  # [batch, seq] absolute positions of the chunk
     valid: Optional[jnp.ndarray] = None,  # [batch, seq] padding mask
     scale: Optional[float] = None,
+    k_scales: Optional[jnp.ndarray] = None,  # [total_pages, n_kv] f32
+    v_scales: Optional[jnp.ndarray] = None,  # (KV_QUANT_HBM: int8 pools)
 ) -> jnp.ndarray:
     """Chunked prefill attending to prefix-cached pages *and* causally within
     the fresh chunk.
@@ -180,12 +182,21 @@ def prefill_with_paged_context(
     qf = q.astype(jnp.float32).reshape(b, s, n_kv, group, d)
 
     # Context keys/values gathered per sequence: [b, n_kv, max_ctx, d].
-    ctx_k = jnp.moveaxis(
-        k_pages[block_tables].reshape(b, max_ctx, n_kv, d), 1, 2
-    )
-    ctx_v = jnp.moveaxis(
-        v_pages[block_tables].reshape(b, max_ctx, n_kv, d), 1, 2
-    )
+    ctx_k = k_pages[block_tables]  # [b, max_ctx_pages, ps, n_kv, d]
+    ctx_v = v_pages[block_tables]
+    if k_scales is not None:
+        # KV_QUANT_HBM=int8: pools hold codes; widen the gathered context
+        # (chunk-sized, not pool-sized) with the per-page-per-head scales.
+        ctx_k = ctx_k.astype(jnp.float32) * (
+            k_scales[block_tables][:, :, None, :, None]
+        )
+        ctx_v = ctx_v.astype(jnp.float32) * (
+            v_scales[block_tables][:, :, None, :, None]
+        )
+        ctx_k = ctx_k.astype(k.dtype)
+        ctx_v = ctx_v.astype(v.dtype)
+    ctx_k = jnp.moveaxis(ctx_k.reshape(b, max_ctx, n_kv, d), 1, 2)
+    ctx_v = jnp.moveaxis(ctx_v.reshape(b, max_ctx, n_kv, d), 1, 2)
 
     # Virtual key sequence: [context ++ chunk]. Context keys are visible to
     # every query (they strictly precede the chunk): position -1 ≤ any

@@ -41,14 +41,16 @@ def _decode_kernel(
     # scalar prefetch
     block_tables_ref,  # [batch, max_pages] int32
     seq_lens_ref,  # [batch] int32
-    # blocks (fresh_*_ref present only when has_fresh)
+    # blocks (scale refs only when quantized; fresh refs only when has_fresh)
     q_ref,  # [1, n_kv, group, head_dim]
     k_ref,  # [1, 1, page_size, n_kv, head_dim] (leading layer dim)
     v_ref,  # [1, 1, page_size, n_kv, head_dim]
-    *refs,  # [fresh_k_ref, fresh_v_ref,] out_ref, m_ref, l_ref, acc_ref
+    *refs,  # [k_scale_ref, v_scale_ref,] [fresh_k_ref, fresh_v_ref,]
+    #        out_ref, m_ref, l_ref, acc_ref
     page_size: int,
     scale: float,
     has_fresh: bool,
+    quantized: bool,
 ):
     """All KV heads of one (sequence, page) in a single program: 8× fewer
     grid steps than a per-head grid, one fully-contiguous page tile
@@ -58,7 +60,20 @@ def _decode_kernel(
     ([1, n_kv, 1, d] blocks) instead of from the pages, and pages hold only
     the ``seq_len - 1`` historical tokens. This lets the caller defer the
     pool write until after attention — one batched scatter per step, never
-    a pool rebuild."""
+    a pool rebuild.
+
+    ``quantized`` (``KV_QUANT_HBM=int8``): the page pools hold int8 codes
+    and the pipeline DMAs HALF the HBM→VMEM bytes per page — the decode
+    hot loop is DMA-bound, so this is a bandwidth win on top of the 2×
+    capacity win. Per-page-per-(layer, kv_head) f32 scales ride as two
+    extra pipelined operands (same block-table index map, so each program
+    sees exactly its page's scales) and the codes dequantize IN-REGISTER
+    to f32 before the online softmax — full-width pages never exist
+    anywhere. The ``has_fresh`` current-token path stays full-precision:
+    fresh K/V arrive unquantized and never round-trip through int8."""
+    if quantized:
+        k_scale_ref, v_scale_ref = refs[0], refs[1]  # [1, 1, n_kv] f32
+        refs = refs[2:]
     if has_fresh:
         fresh_k_ref, fresh_v_ref, out_ref, m_ref, l_ref, acc_ref = refs
     else:
@@ -83,6 +98,11 @@ def _decode_kernel(
         # block); swap to head-major for the batched dot.
         k = jnp.swapaxes(k_ref[0, 0].astype(jnp.float32), 0, 1)  # [n_kv, ps, d]
         v = jnp.swapaxes(v_ref[0, 0].astype(jnp.float32), 0, 1)
+        if quantized:
+            # int8 codes → f32, per-(layer, kv_head) page scale broadcast
+            # over slots and lanes. Registers only; VMEM holds the codes.
+            k = k * k_scale_ref[0, 0][:, None, None]
+            v = v * v_scale_ref[0, 0][:, None, None]
 
         # Batched over kv heads: [n_kv, group, page_size]
         scores = jax.lax.dot_general(
@@ -152,6 +172,8 @@ def paged_attention(
     fresh_k: Optional[jnp.ndarray] = None,  # [batch, n_kv_heads, head_dim]
     fresh_v: Optional[jnp.ndarray] = None,
     *,
+    k_scale: Optional[jnp.ndarray] = None,  # [(n_layers,) total_pages, n_kv] f32
+    v_scale: Optional[jnp.ndarray] = None,
     page_size: Optional[int] = None,
     scale: Optional[float] = None,
     interpret: bool = False,
@@ -176,11 +198,24 @@ def paged_attention(
     as the decode pool-size throughput cliff, benchmarking/
     bench_decode_poolsize.py); with the 5-D operand the custom call
     reads the carry buffer in place and DMAs only the block-table pages.
+
+    With ``k_scale``/``v_scale`` (``KV_QUANT_HBM=int8``), the pools hold
+    int8 codes and the per-page-per-(layer, kv_head) f32 scales ride as
+    two extra pipelined operands — half the page DMA bytes, dequantized
+    in-register inside the kernel. The scalar-prefetch operand set
+    (block_tables, seq_lens) is IDENTICAL in both variants; kvlint pins
+    the full operand order against tools/kvlint/kernel_abi.json.
     """
     batch, n_heads, head_dim = q.shape
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    quantized = k_scale is not None
     if k_pages.ndim == 4:  # single-layer callers: free bitcast, layer 0
         k_pages = k_pages[None]
         v_pages = v_pages[None]
+        if quantized:
+            k_scale = k_scale[None]
+            v_scale = v_scale[None]
         layer = 0
     _L, _total, ps, n_kv_heads, _hd = k_pages.shape
     page_size = ps if page_size is None else page_size
@@ -217,6 +252,18 @@ def paged_attention(
         pl.BlockSpec((1, 1, page_size, n_kv_heads, head_dim), kv_index),
     ]
     inputs = [block_tables, seq_lens, q_blocked, k_pages, v_pages]
+    if quantized:
+        # Same block-table deref as the page tiles, so each program's
+        # pipeline stage carries its page's [n_kv] scale row alongside
+        # the codes. Appended after v_pages, before fresh operands —
+        # order is part of the kernel ABI (tools/kvlint/kernel_abi.json).
+        def scale_index(b, p, bt, sl):
+            return (layer, bt[b, p], 0)
+
+        in_specs.append(pl.BlockSpec((1, 1, n_kv_heads), scale_index))
+        in_specs.append(pl.BlockSpec((1, 1, n_kv_heads), scale_index))
+        inputs.append(k_scale)
+        inputs.append(v_scale)
     if has_fresh:
         in_specs.append(pl.BlockSpec((1, n_kv_heads, 1, head_dim), q_index))
         in_specs.append(pl.BlockSpec((1, n_kv_heads, 1, head_dim), q_index))
@@ -236,7 +283,11 @@ def paged_attention(
     )
 
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, scale=scale, has_fresh=has_fresh
+        _decode_kernel,
+        page_size=page_size,
+        scale=scale,
+        has_fresh=has_fresh,
+        quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,

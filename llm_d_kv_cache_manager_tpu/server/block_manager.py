@@ -19,6 +19,12 @@ ecosystem, designed so the routing indexer can track this engine's cache:
   ``BlockStored`` when a full page is registered, ``BlockRemoved`` when an
   evictable page is recycled — the engine forwards them to the ZMQ
   publisher (write path of SURVEY §3.2).
+
+The allocator is *width-agnostic*: it tracks page identity, hashes, and
+tier membership (HBM / host DRAM / remote) but never touches page bytes,
+so the same lifecycle drives full-width bf16 pools and the int8 pools of
+``KV_QUANT_HBM`` — storage width is the engine's concern (its movers ship
+codes + scales between tiers; see ``Engine._flush_page_moves``).
 """
 
 from __future__ import annotations

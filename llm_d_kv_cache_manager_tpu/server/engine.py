@@ -176,6 +176,20 @@ class EngineConfig:
     #: bring-back/import BEFORE re-entering the Pallas paged-attention
     #: path; the device-side kernels never see an int8 page.
     kv_quant: Optional[str] = None
+    #: paged-KV quantization for the HBM tier itself (ISSUE 16,
+    #: ``KV_QUANT_HBM``): None (full-width bf16 pages in HBM, bit-identical
+    #: legacy) or "int8" (the page pools hold int8 codes plus a per-page-
+    #: per-(layer, kv_head) f32 scale pool; the Pallas decode kernel DMAs
+    #: half the bytes per page and dequantizes in-register). Doubles the
+    #: blocks a fixed HBM budget holds — read the MRC's 2x point
+    #: (docs/operations.md) to forecast the hit-rate payoff BEFORE turning
+    #: this on. "float8_e4m3" is reserved (declared follow-on storage
+    #: mode; rejected with NotImplementedError until the kernel grows an
+    #: fp8 dequant path). Composes with ``kv_quant``: with both int8, a
+    #: page's codes+scales move host↔HBM and onto the wire directly,
+    #: never widening. Incompatible (rejected at init) with sp>1,
+    #: spec_decode, and the pallas prefill kernel.
+    kv_quant_hbm: Optional[str] = None
     #: host-tier prefetch: bring a waiting sequence's host-cached prefix
     #: back into HBM ahead of the scheduler (device↔host copies overlap
     #: the current step) instead of restoring synchronously inside
@@ -294,6 +308,33 @@ class Engine:
                 raise ValueError("spec_ngram must be >= 1")
             if config.spec_rounds < 1:
                 raise ValueError("spec_rounds must be >= 1")
+        if config.kv_quant_hbm is not None:
+            if config.kv_quant_hbm not in quant.KV_QUANT_HBM_MODES:
+                raise ValueError(
+                    f"unknown kv_quant_hbm mode {config.kv_quant_hbm!r}"
+                )
+            if config.kv_quant_hbm == "float8_e4m3":
+                raise NotImplementedError(
+                    "kv_quant_hbm='float8_e4m3' is the declared follow-on "
+                    "storage mode; the paged-attention kernel has no fp8 "
+                    "dequant path yet — use 'int8'"
+                )
+            # Scope limits: the quantized pools thread through the decode
+            # kernel and the xla prefill context gather only. The sp ring,
+            # the pallas prefill kernel, and the fused spec-decode scan all
+            # read pages full-width and would silently widen — reject
+            # rather than quietly fall back.
+            if config.sp > 1:
+                raise ValueError("kv_quant_hbm is incompatible with sp > 1")
+            if config.spec_decode != "off":
+                raise ValueError(
+                    "kv_quant_hbm is incompatible with spec_decode"
+                )
+            if config.prefill_attn == "pallas":
+                raise ValueError(
+                    "kv_quant_hbm requires the xla prefill path "
+                    "(prefill_attn='auto' or 'xla')"
+                )
         #: speculative-decode observability: proposed/accepted draft
         #: tokens, verify ROUNDS, and host-sync bursts (acceptance rate =
         #: accepted/proposed; rounds-per-sync = verify_steps/bursts).
@@ -302,8 +343,13 @@ class Engine:
         }
         self.prefill_attn = config.prefill_attn
         if self.prefill_attn == "auto":
+            # kv_quant_hbm pins prefill to the xla path (the flash-prefill
+            # kernel reads pages full-width); otherwise TPU gets the kernel.
             self.prefill_attn = (
-                "pallas" if jax.default_backend() == "tpu" else "xla"
+                "pallas"
+                if jax.default_backend() == "tpu"
+                and config.kv_quant_hbm is None
+                else "xla"
             )
         self.mesh = None
         if config.tp > 1 or config.sp > 1:
@@ -338,12 +384,29 @@ class Engine:
             params = shard_params(params, self.mesh, cfg)
         self.params = params
         self.k_pages, self.v_pages = llama.init_kv_pages(
-            cfg, config.block_manager.total_pages, ps
+            cfg, config.block_manager.total_pages, ps,
+            kv_quant_hbm=config.kv_quant_hbm,
         )
+        # Scale pools ride alongside the int8 page pools (None when the
+        # knob is off — every scale-threading call site keys off this).
+        self.k_scales: Optional[jnp.ndarray] = None
+        self.v_scales: Optional[jnp.ndarray] = None
+        if config.kv_quant_hbm == "int8":
+            self.k_scales, self.v_scales = llama.init_kv_scales(
+                cfg, config.block_manager.total_pages
+            )
         if self.mesh is not None:
             sh = kv_pages_sharding(self.mesh)
             self.k_pages = jax.device_put(self.k_pages, sh)
             self.v_pages = jax.device_put(self.v_pages, sh)
+            if self.k_scales is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                ssh = NamedSharding(
+                    self.mesh, PartitionSpec(None, None, "tp")
+                )
+                self.k_scales = jax.device_put(self.k_scales, ssh)
+                self.v_scales = jax.device_put(self.v_scales, ssh)
 
         # Online rate estimates driving the recompute-vs-restore cost
         # model (EMAs, measured on the real dispatches of THIS process —
@@ -357,15 +420,22 @@ class Engine:
         # Host-DRAM offload tier: numpy slot pool + jitted page movers.
         # With kv_quant="int8" the slot pool is int8 + per-(layer, head)
         # f32 scales — half the bytes per page of a bf16 pool, so a fixed
-        # host-DRAM budget holds ~2x the blocks.
+        # host-DRAM budget holds ~2x the blocks. kv_quant_hbm="int8" forces
+        # the same host layout regardless of kv_quant: the HBM source is
+        # already int8 codes+scales, so storing the host tier full-width
+        # would DOUBLE host bytes and add a dequant→requant round trip per
+        # spill/restore — with the HBM knob on, the whole ladder is int8.
         if config.kv_quant is not None:
             if config.kv_quant not in quant.KV_QUANT_MODES:
                 raise ValueError(f"unknown kv_quant mode {config.kv_quant!r}")
+        self._host_int8 = (
+            config.kv_quant == "int8" or config.kv_quant_hbm == "int8"
+        )
         hp = config.block_manager.host_pages
         if hp > 0:
             slot_shape = (hp, cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
             np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
-            if config.kv_quant == "int8":
+            if self._host_int8:
                 self._host_k = np.zeros(slot_shape, np.int8)
                 self._host_v = np.zeros(slot_shape, np.int8)
                 sc_shape = (hp,) + quant.kv_scale_shape(slot_shape[1:])
@@ -549,8 +619,9 @@ class Engine:
         when the tier is int8), snapshotted so they outlive slot reuse —
         the restore scatter's source. (Exports read the slot pools
         directly: quantized wire ships the stored codes, and tobytes()
-        needs no snapshot.)"""
-        if self.config.kv_quant == "int8":
+        needs no snapshot.) NOT used under kv_quant_hbm: the quantized
+        HBM pool wants the codes themselves — see ``_restore_page``."""
+        if self._host_int8:
             np_dtype = np.dtype(jnp.dtype(self.model_cfg.dtype).name)
             return (
                 quant.dequantize_kv_page(
@@ -565,7 +636,21 @@ class Engine:
     def _restore_page(self, slot: int, page: int) -> None:
         src = self._off_by_slot.get(slot)
         if src is None:
-            src = ("data",) + self._read_host_slot(slot)
+            if self.config.kv_quant_hbm == "int8" and self._host_int8:
+                # Both tiers store the same int8 codes + per-(layer, head)
+                # scales: bring the block back by COPYING them, never by
+                # dequantizing through a full-width staging page (which
+                # would both double the staged bytes and re-quantize —
+                # an avoidable second rounding).
+                src = (
+                    "qdata",
+                    self._host_k[slot].copy(),
+                    self._host_v[slot].copy(),
+                    self._host_k_scale[slot].copy(),
+                    self._host_v_scale[slot].copy(),
+                )
+            else:
+                src = ("data",) + self._read_host_slot(slot)
         self._pending_restores.append((page, src))
         self._restore_by_page[page] = src
 
@@ -585,7 +670,7 @@ class Engine:
         else:  # host_dram
             src = self._off_by_slot.get(idx)
             if src is None:
-                if self.config.kv_quant == "int8":
+                if self._host_int8:
                     # Ship the stored int8 codes + scales directly — the
                     # PR 6 wire triple, no dequant/requant round trip.
                     src = (
@@ -611,8 +696,10 @@ class Engine:
         cfg = self.model_cfg
         ps = self.page_size
         shape = (cfg.n_layers, ps, cfg.n_kv_heads, cfg.hd)
+        sc_shape = quant.kv_scale_shape(shape)
         np_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
-        quantize_wire = self.config.kv_quant == "int8"
+        hbmq = self.config.kv_quant_hbm == "int8"
+        quantize_wire = self.config.kv_quant == "int8" or hbmq
         payloads = []
         for info, src in self._pending_demotions:
             extra = {}
@@ -620,8 +707,22 @@ class Engine:
                 kd, vd = src[1], src[2]
                 extra = {
                     "quant": "int8",
-                    "k_scale": src[3].tobytes(),
-                    "v_scale": src[4].tobytes(),
+                    "k_scale": np.ascontiguousarray(
+                        src[3], np.float32
+                    ).tobytes(),
+                    "v_scale": np.ascontiguousarray(
+                        src[4], np.float32
+                    ).tobytes(),
+                }
+            elif src[0] == "page" and hbmq:
+                # Quantized HBM: the flush gather already carries the
+                # stored codes + scales — ship them as-is (the wire scale
+                # layout is the host tier's [L, 1, n_kv, 1]).
+                kd, vd, sk, sv = page_data[src[1]]
+                extra = {
+                    "quant": "int8",
+                    "k_scale": sk.reshape(sc_shape).tobytes(),
+                    "v_scale": sv.reshape(sc_shape).tobytes(),
                 }
             else:
                 kd, vd = (
@@ -773,6 +874,7 @@ class Engine:
         ):
             if src[0] == "page" and src[1] not in need:
                 need.append(src[1])
+        hbmq = self.config.kv_quant_hbm == "int8"
         page_data = {}
         if need:
             # Bucket the gather width to limit compile count.
@@ -781,6 +883,17 @@ class Engine:
             t_gather = time.perf_counter()
             k_data = np.asarray(_read_pages_batch(self.k_pages, jnp.asarray(idx)))
             v_data = np.asarray(_read_pages_batch(self.v_pages, jnp.asarray(idx)))
+            if hbmq:
+                # Quantized HBM: the gathered pages are int8 codes — pull
+                # their [L, n_kv] scale rows through the same batched
+                # mover (scale pools index axis 1 exactly like the page
+                # pools, so the jitted gather is reused as-is).
+                k_sc = np.asarray(
+                    _read_pages_batch(self.k_scales, jnp.asarray(idx))
+                )
+                v_sc = np.asarray(
+                    _read_pages_batch(self.v_scales, jnp.asarray(idx))
+                )
             # D2H rate sample (np.asarray fences): the cost model's
             # link-bandwidth bound, available from the first spill. Divide
             # by the PADDED gather width — those pages were actually
@@ -793,12 +906,47 @@ class Engine:
                 n / max(time.perf_counter() - t_gather, 1e-6),
             )
             for i, p in enumerate(need):
-                page_data[p] = (k_data[:, i], v_data[:, i])
+                page_data[p] = (
+                    (k_data[:, i], v_data[:, i], k_sc[:, i], v_sc[:, i])
+                    if hbmq
+                    else (k_data[:, i], v_data[:, i])
+                )
 
         def resolve(src):
             return page_data[src[1]] if src[0] == "page" else (src[1], src[2])
 
-        if self.config.kv_quant == "int8":
+        def resolve_q(src):
+            """Mixed-width source → (k codes, v codes, k scales, v scales)
+            with scales in the HBM pool's [L, n_kv] layout. Every tier
+            crossing under kv_quant_hbm lands here: stored codes move
+            as-is, and only genuinely full-width sources (a legacy peer's
+            unquantized import) pay a quantize."""
+            if src[0] == "page":
+                return page_data[src[1]]
+            if src[0] == "qdata":
+                L = self.model_cfg.n_layers
+                n_kv = self.model_cfg.n_kv_heads
+                return (
+                    src[1], src[2],
+                    np.asarray(src[3], np.float32).reshape(L, n_kv),
+                    np.asarray(src[4], np.float32).reshape(L, n_kv),
+                )
+            kq, sk = quant.quantize_kv_page(src[1])
+            vq, sv = quant.quantize_kv_page(src[2])
+            return (
+                kq, vq,
+                sk.reshape(sk.shape[0], -1), sv.reshape(sv.shape[0], -1),
+            )
+
+        if hbmq:
+            sc_host = (self.model_cfg.n_layers, 1, self.model_cfg.n_kv_heads, 1)
+            for slot, src in self._pending_offloads:
+                kd, vd, sk, sv = resolve_q(src)
+                self._host_k[slot] = kd
+                self._host_v[slot] = vd
+                self._host_k_scale[slot] = sk.reshape(sc_host)
+                self._host_v_scale[slot] = sv.reshape(sc_host)
+        elif self.config.kv_quant == "int8":
             for slot, src in self._pending_offloads:
                 kd, vd = resolve(src)
                 self._host_k[slot], self._host_k_scale[slot] = (
@@ -824,7 +972,10 @@ class Engine:
             # scatter indices have no ordering guarantee in XLA).
             by_dst = {p: src for p, src in self._pending_restores}
             dst = list(by_dst.keys())
-            datas = [resolve(src) for src in by_dst.values()]
+            datas = [
+                (resolve_q if hbmq else resolve)(src)
+                for src in by_dst.values()
+            ]
             n = 1 << (len(dst) - 1).bit_length()
             pad = n - len(dst)
             idx = jnp.asarray(dst + [total] * pad, jnp.int32)  # pad → drop
@@ -836,6 +987,22 @@ class Engine:
             self.v_pages = _write_pages_batch(
                 self.v_pages, idx, jnp.asarray(v_stack)
             )
+            if hbmq:
+                # Scales land through the same scatter (axis-1 indexed
+                # pools), so a restored page and its scale commit in the
+                # same flush — never a codes/scale skew window.
+                ks_stack = np.stack(
+                    [d[2] for d in datas] + [datas[0][2]] * pad, 1
+                )
+                vs_stack = np.stack(
+                    [d[3] for d in datas] + [datas[0][3]] * pad, 1
+                )
+                self.k_scales = _write_pages_batch(
+                    self.k_scales, idx, jnp.asarray(ks_stack)
+                )
+                self.v_scales = _write_pages_batch(
+                    self.v_scales, idx, jnp.asarray(vs_stack)
+                )
             # Fence with a scalar fetch (block_until_ready is lazy on the
             # tunnel) so the restore-rate sample covers the real DMA.
             # Padded-width divisor, same rationale as the offload sample.
@@ -876,7 +1043,10 @@ class Engine:
         ~2x and wrongly decline break-even pulls."""
         cfg = self.model_cfg
         elems = cfg.n_layers * self.page_size * cfg.n_kv_heads * cfg.hd
-        if self.config.kv_quant == "int8":
+        if (
+            self.config.kv_quant == "int8"
+            or self.config.kv_quant_hbm == "int8"
+        ):
             return 2 * (elems + cfg.n_layers * cfg.n_kv_heads * 4)
         return 2 * elems * jnp.dtype(cfg.dtype).itemsize
 
@@ -905,7 +1075,8 @@ class Engine:
                 self.transfer_stats["exported_blocks"] += len(remote_tail)
             return remote_tail
         dev = [(i, idx) for i, (_, _, tier, idx) in enumerate(chain) if tier == "tpu_hbm"]
-        page_data: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        hbmq = self.config.kv_quant_hbm == "int8"
+        page_data: dict[int, tuple] = {}
         if dev:
             # Bucket the gather width to a power of two (the flush path's
             # rule): peers fetch chains of arbitrary length, and an
@@ -916,10 +1087,25 @@ class Engine:
             idx = jnp.asarray(pages + [pages[0]] * (n - len(pages)), jnp.int32)
             k = np.asarray(_read_pages_batch(self.k_pages, idx))
             v = np.asarray(_read_pages_batch(self.v_pages, idx))
+            if hbmq:
+                k_sc = np.asarray(_read_pages_batch(self.k_scales, idx))
+                v_sc = np.asarray(_read_pages_batch(self.v_scales, idx))
             for j, (i, _) in enumerate(dev):
-                page_data[i] = (k[:, j], v[:, j])
-        quantize_wire = self.config.kv_quant == "int8"
+                page_data[i] = (
+                    (k[:, j], v[:, j], k_sc[:, j], v_sc[:, j])
+                    if hbmq
+                    else (k[:, j], v[:, j])
+                )
+        quantize_wire = self.config.kv_quant == "int8" or hbmq
         np_dtype = np.dtype(jnp.dtype(self.model_cfg.dtype).name)
+        sc_shape = quant.kv_scale_shape(
+            (
+                self.model_cfg.n_layers,
+                self.page_size,
+                self.model_cfg.n_kv_heads,
+                self.model_cfg.hd,
+            )
+        )
         blocks = []
         for i, (h, info, tier, idx) in enumerate(chain):
             # Halved wire bytes under kv_quant: ship int8 + f32 scales;
@@ -931,22 +1117,33 @@ class Engine:
             extra = {}
             qshape: tuple
             if tier == "tpu_hbm":
-                kd, vd = page_data[i]
-                qshape = tuple(kd.shape)
-                if quantize_wire:
-                    kd, sk = quant.quantize_kv_page(kd)
-                    vd, sv = quant.quantize_kv_page(vd)
+                if hbmq:
+                    # Quantized HBM: the gathered pages ARE the stored
+                    # codes — ship them with their scales, no widening.
+                    kd, vd, sk_, sv_ = page_data[i]
+                    qshape = tuple(kd.shape)
                     extra = {
                         "quant": "int8",
-                        "k_scale": sk.tobytes(),
-                        "v_scale": sv.tobytes(),
+                        "k_scale": sk_.reshape(sc_shape).tobytes(),
+                        "v_scale": sv_.reshape(sc_shape).tobytes(),
                     }
+                else:
+                    kd, vd = page_data[i]
+                    qshape = tuple(kd.shape)
+                    if quantize_wire:
+                        kd, sk = quant.quantize_kv_page(kd)
+                        vd, sv = quant.quantize_kv_page(vd)
+                        extra = {
+                            "quant": "int8",
+                            "k_scale": sk.tobytes(),
+                            "v_scale": sv.tobytes(),
+                        }
             else:
                 # Views into the slot pools; tobytes() below materializes
                 # C-order bytes without a staging copy.
                 kd, vd = self._host_k[idx], self._host_v[idx]
                 qshape = tuple(kd.shape)
-                if quantize_wire:
+                if self._host_int8:
                     extra = {
                         "quant": "int8",
                         "k_scale": self._host_k_scale[idx].tobytes(),
@@ -1066,20 +1263,25 @@ class Engine:
                 continue
             if quantized:
                 sc_shape = quant.kv_scale_shape(expected_shape)
-                k = quant.dequantize_kv_page(
-                    np.frombuffer(blk.k_data, np.int8).reshape(expected_shape),
-                    np.frombuffer(blk.k_scale, np.float32).reshape(sc_shape),
-                    np_dtype,
-                )
-                v = quant.dequantize_kv_page(
-                    np.frombuffer(blk.v_data, np.int8).reshape(expected_shape),
-                    np.frombuffer(blk.v_scale, np.float32).reshape(sc_shape),
-                    np_dtype,
-                )
+                kq = np.frombuffer(blk.k_data, np.int8).reshape(expected_shape)
+                vq = np.frombuffer(blk.v_data, np.int8).reshape(expected_shape)
+                ksc = np.frombuffer(blk.k_scale, np.float32).reshape(sc_shape)
+                vsc = np.frombuffer(blk.v_scale, np.float32).reshape(sc_shape)
+                if self.config.kv_quant_hbm == "int8":
+                    # Quantized pool: land the peer's codes + scales as-is
+                    # (the batched flush scatters them into the int8 page
+                    # pool and the scale pool) — imports never widen.
+                    src = ("qdata", kq, vq, ksc, vsc)
+                else:
+                    src = (
+                        "data",
+                        quant.dequantize_kv_page(kq, ksc, np_dtype),
+                        quant.dequantize_kv_page(vq, vsc, np_dtype),
+                    )
             else:
                 k = np.frombuffer(blk.k_data, dtype=np_dtype).reshape(expected_shape)
                 v = np.frombuffer(blk.v_data, dtype=np_dtype).reshape(expected_shape)
-            src = ("data", k, v)
+                src = ("data", k, v)
             self._pending_restores.append((page, src))
             self._restore_by_page[page] = src
             installed += 1
@@ -1355,7 +1557,7 @@ class Engine:
         # before this prefill overwrites them).
         self._flush_page_moves()
         t0 = time.perf_counter()
-        logits, self.k_pages, self.v_pages = llama.prefill(
+        out = llama.prefill(
             self.params,
             self.model_cfg,
             jnp.asarray(tokens),
@@ -1369,7 +1571,16 @@ class Engine:
             jnp.asarray(ctx_lens),
             mesh=self.mesh,
             attn_impl=self.prefill_attn,
+            k_scales=self.k_scales,
+            v_scales=self.v_scales,
         )
+        if self.k_scales is None:
+            logits, self.k_pages, self.v_pages = out
+        else:
+            (
+                logits, self.k_pages, self.v_pages,
+                self.k_scales, self.v_scales,
+            ) = out
         first_tokens = self._sample(logits, seqs)  # syncs the dispatch
         # Online prefill-rate sample for the recompute-vs-restore model
         # (chunk tokens over the synced dispatch wall time).
@@ -1568,7 +1779,7 @@ class Engine:
         # immediately before the device call.
         self._flush_page_moves()
         self._rng, key = jax.random.split(self._rng)
-        toks, self.k_pages, self.v_pages = llama.decode_steps(
+        out = llama.decode_steps(
             self.params,
             self.model_cfg,
             tokens_dev,
@@ -1585,7 +1796,16 @@ class Engine:
             num_steps=k,
             interpret=self.config.interpret,
             mesh=self.mesh,
+            k_scales=self.k_scales,
+            v_scales=self.v_scales,
         )
+        if self.k_scales is None:
+            toks, self.k_pages, self.v_pages = out
+        else:
+            (
+                toks, self.k_pages, self.v_pages,
+                self.k_scales, self.v_scales,
+            ) = out
         if self.config.decode_fused_sampling:
             # Start the batched D2H copy of this burst's sampled ids NOW,
             # overlapped with whatever dispatches next — by the time the

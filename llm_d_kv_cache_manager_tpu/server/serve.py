@@ -19,7 +19,8 @@ Config comes from env vars mirroring the reference's online service
 ``POD_IDENTIFIER``, ``ZMQ_ENDPOINT``, ``BLOCK_SIZE``, ``PYTHONHASHSEED``,
 ``HTTP_PORT``, plus engine sizing (``TOTAL_PAGES``, ``HOST_PAGES``, ``TP``,
 ``MAX_MODEL_LEN``, ``DP_RANK``), the KV capacity tiers (``KV_QUANT``,
-``HOST_PREFETCH``, ``HOST_TIER_POLICY``) and the cross-pod KV transfer plane
+``KV_QUANT_HBM``, ``HOST_PREFETCH``, ``HOST_TIER_POLICY``) and the
+cross-pod KV transfer plane
 (``TRANSFER_ENDPOINT`` binds this pod's page export service — unset = off;
 ``TRANSFER_MAX_BLOCKS``, ``TRANSFER_TIMEOUT_S``; ``ASYNC_PULL`` +
 ``PULL_WORKERS`` import pulled prefixes in the background instead of
@@ -861,6 +862,12 @@ class PodServerConfig:
         # wire bytes halve; pages dequantize before re-entering the
         # attention path. Unset = full-width pages, bit-identical legacy.
         eng.kv_quant = os.environ.get("KV_QUANT") or None
+        # HBM-resident KV quantization ("int8"): the page pools themselves
+        # hold int8 codes + per-page scales, doubling the blocks a chip's
+        # HBM budget holds; the Pallas decode kernel dequantizes
+        # in-register. Read the MRC's 2x point (docs/operations.md) before
+        # enabling. Unset = full-width HBM pages, bit-identical legacy.
+        eng.kv_quant_hbm = os.environ.get("KV_QUANT_HBM") or None
         # Host-tier prefetch: bring-back ahead of the scheduler instead of
         # blocking inside allocate (needs HOST_PAGES > 0).
         eng.host_prefetch = _env_bool("HOST_PREFETCH", "0")
@@ -2798,6 +2805,15 @@ class PodServer:
                     "prefetch_enabled": self.config.engine.host_prefetch,
                     **dict(bm.host_stats),
                     "prefetch": dict(self.engine.host_prefetch_stats),
+                }
+            if self.config.engine.kv_quant_hbm is not None:
+                # Only when the HBM-quant knob is on: the knobs-off /stats
+                # payload stays bit-identical (same rule as every tier
+                # block above).
+                payload["kv_quant_hbm"] = {
+                    "mode": self.config.engine.kv_quant_hbm,
+                    "total_pages": bm.config.total_pages,
+                    "pool_dtype": str(self.engine.k_pages.dtype),
                 }
             if self.config.obs_tracing or self.config.obs_metrics:
                 # Only with an OBS_* knob on: the knobs-off /stats payload

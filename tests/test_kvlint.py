@@ -604,6 +604,122 @@ class TestLockDiscipline:
 
 
 # ---------------------------------------------------------------------------
+# kernel-abi
+# ---------------------------------------------------------------------------
+
+_ABI_MANIFEST = "tools/kvlint/kernel_abi.json"
+_KERNEL_MOD = "ops/paged_attention.py"
+
+_KERNEL_OK = """
+def paged_attention(q, k_pages, v_pages, bt, sl, k_scale=None, fresh_k=None):
+    q_blocked = q.reshape(1, 2, 2, 8)
+    inputs = [bt, sl, q_blocked, k_pages, v_pages]
+    if k_scale is not None:
+        inputs.append(k_scale)
+    if fresh_k is not None:
+        inputs.append(fresh_k.reshape(1, 2, 1, 8))
+    grid_spec = PrefetchScalarGridSpec(num_scalar_prefetch=2, grid=(1,))
+    return inputs, grid_spec
+"""
+
+_ABI_OK = """
+{"ops/paged_attention.py":
+  {"paged_attention": {
+    "num_scalar_prefetch": 2,
+    "operands": ["bt", "sl", "q_blocked", "k_pages", "v_pages",
+                 "k_scale", "fresh_k"]}}}
+"""
+
+
+def _abi_repo(tmp_path: Path, body: str, manifest: str) -> Path:
+    return _mini_repo(
+        tmp_path, **{_ABI_MANIFEST: manifest, _KERNEL_MOD: body}
+    )
+
+
+class TestKernelAbi:
+    def test_matching_pin_passes(self, tmp_path):
+        root = _abi_repo(tmp_path, _KERNEL_OK, _ABI_OK)
+        assert _lint(root, _KERNEL_MOD, "kernel-abi") == []
+
+    def test_variant_tail_reorder_flagged(self, tmp_path):
+        # Fresh operands appended before the scales: compiles fine, reads
+        # scales as fresh K inside the kernel — exactly what the pin is for.
+        swapped = _KERNEL_OK.replace(
+            """    if k_scale is not None:
+        inputs.append(k_scale)
+    if fresh_k is not None:
+        inputs.append(fresh_k.reshape(1, 2, 1, 8))""",
+            """    if fresh_k is not None:
+        inputs.append(fresh_k.reshape(1, 2, 1, 8))
+    if k_scale is not None:
+        inputs.append(k_scale)""",
+        )
+        root = _abi_repo(tmp_path, swapped, _ABI_OK)
+        findings = _lint(root, _KERNEL_MOD, "kernel-abi")
+        assert len(findings) == 1
+        assert "operand order" in findings[0].message
+
+    def test_seed_list_reorder_flagged(self, tmp_path):
+        root = _abi_repo(
+            tmp_path,
+            _KERNEL_OK.replace(
+                "[bt, sl, q_blocked, k_pages, v_pages]",
+                "[bt, sl, q_blocked, v_pages, k_pages]",
+            ),
+            _ABI_OK,
+        )
+        assert len(_lint(root, _KERNEL_MOD, "kernel-abi")) == 1
+
+    def test_unpinned_new_operand_flagged(self, tmp_path):
+        grown = _KERNEL_OK.replace(
+            "grid_spec = PrefetchScalarGridSpec",
+            "inputs.append(bt)\n    grid_spec = PrefetchScalarGridSpec",
+        )
+        root = _abi_repo(tmp_path, grown, _ABI_OK)
+        findings = _lint(root, _KERNEL_MOD, "kernel-abi")
+        assert len(findings) == 1
+        assert "update" in findings[0].message
+
+    def test_prefetch_count_change_flagged(self, tmp_path):
+        root = _abi_repo(
+            tmp_path,
+            _KERNEL_OK.replace("num_scalar_prefetch=2", "num_scalar_prefetch=3"),
+            _ABI_OK,
+        )
+        findings = _lint(root, _KERNEL_MOD, "kernel-abi")
+        assert len(findings) == 1
+        assert "num_scalar_prefetch" in findings[0].message
+
+    def test_pinned_function_removed_flagged(self, tmp_path):
+        root = _abi_repo(
+            tmp_path,
+            _KERNEL_OK.replace("def paged_attention", "def renamed_attention"),
+            _ABI_OK,
+        )
+        findings = _lint(root, _KERNEL_MOD, "kernel-abi")
+        assert len(findings) == 1
+        assert "no longer exists" in findings[0].message
+
+    def test_committed_manifest_pins_the_real_kernel(self):
+        import json
+
+        manifest = json.loads(
+            (REPO_ROOT / "tools/kvlint/kernel_abi.json").read_text()
+        )
+        pin = manifest["llm_d_kv_cache_manager_tpu/ops/paged_attention.py"][
+            "paged_attention"
+        ]
+        # The scalar-prefetch operands lead in BOTH kernel variants, and
+        # the quantized scales sit between the pages and the fresh tail.
+        assert pin["num_scalar_prefetch"] == 2
+        assert pin["operands"][:2] == ["block_tables", "seq_lens"]
+        ops = pin["operands"]
+        assert ops.index("k_scale") > ops.index("v_pages")
+        assert ops.index("v_scale") < ops.index("fresh_k")
+
+
+# ---------------------------------------------------------------------------
 # committed tree stays clean (the CI gate invariant)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,189 @@
+"""kernel-abi: variant kernels must keep one pinned operand order.
+
+The paged-attention decode kernel now has VARIANTS (quantized int8 pools
+vs full-width, with/without the fresh current token) built from one
+kernel body plus conditional operand appends. The whole scheme only
+works if every variant is a strict *prefix-plus-tail* of one canonical
+operand order: the kernel body indexes ``*refs`` positionally, and the
+scalar-prefetch operands (block_tables, seq_lens) MUST stay in front —
+``PrefetchScalarGridSpec`` derives the index maps' prefetch arguments
+from their count and position. An innocent-looking reorder (say,
+appending the fresh operands before the scales) compiles fine and then
+reads scales as fresh K inside the kernel.
+
+So the operand order is an ABI, pinned the same way the wire format is:
+this checker extracts, per manifest'd wrapper function,
+
+- the positional seed list (``inputs = [...]``) and every subsequent
+  ``inputs.append(...)`` in source order (conditional appends included —
+  the conditionals ARE the variant tails), rooting each operand at its
+  underlying name (``fresh_k.reshape(...)`` pins as ``fresh_k``), and
+- the ``num_scalar_prefetch=`` literal of the grid spec,
+
+and compares both against ``tools/kvlint/kernel_abi.json``. Any drift —
+reorder, insertion, removal, a prefetch-count change, a function or
+manifest entry gone missing — is flagged until the manifest is updated,
+making kernel-ABI changes reviewed, diff-visible acts.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import Optional
+
+from tools.kvlint.core import Finding, ModuleUnit, RepoContext
+
+RULE = "kernel-abi"
+
+MANIFEST_REL = "tools/kvlint/kernel_abi.json"
+
+
+def _load_manifest(ctx: RepoContext) -> Optional[dict]:
+    text = ctx.read_repo_file(MANIFEST_REL)
+    if text is None:
+        return None
+    try:
+        return json.loads(text)
+    except ValueError:
+        return None
+
+
+def _module_entry(manifest: dict, unit: ModuleUnit) -> Optional[dict]:
+    for key, entry in manifest.items():
+        if unit.rel.endswith(key):
+            return entry
+    return None
+
+
+def _root_name(node: ast.expr) -> str:
+    """Pin an operand expression to its root name: ``fresh_k.reshape(...)``
+    and ``k_pages[None]`` are still the ``fresh_k`` / ``k_pages`` operand."""
+    if isinstance(node, ast.Call):
+        return _root_name(node.func)
+    if isinstance(node, (ast.Attribute, ast.Subscript)):
+        return _root_name(node.value)
+    if isinstance(node, ast.Name):
+        return node.id
+    return ast.unparse(node)
+
+
+def _extract_operands(
+    fn: ast.FunctionDef, var: str = "inputs"
+) -> tuple[list[str], int]:
+    """Source-order operand names: the ``inputs = [...]`` seed plus every
+    ``inputs.append(x)`` after it (conditional branches included — they
+    are the variant tails the ABI pins). Returns (names, line_of_seed)."""
+    names: list[str] = []
+    seed_line = fn.lineno
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == var
+            and isinstance(node.value, ast.List)
+        ):
+            names = [_root_name(e) for e in node.value.elts]
+            seed_line = node.lineno
+        elif (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr == "append"
+            and isinstance(node.value.func.value, ast.Name)
+            and node.value.func.value.id == var
+            and node.value.args
+        ):
+            names.append(_root_name(node.value.args[0]))
+    return names, seed_line
+
+
+def _called_name(node: ast.expr) -> str:
+    """The name actually called: ``pltpu.PrefetchScalarGridSpec`` →
+    ``PrefetchScalarGridSpec`` (module alias stripped, unlike _root_name)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _extract_prefetch_count(fn: ast.FunctionDef) -> Optional[int]:
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and _called_name(node.func) == "PrefetchScalarGridSpec"
+        ):
+            for kw in node.keywords:
+                if kw.arg == "num_scalar_prefetch" and isinstance(
+                    kw.value, ast.Constant
+                ):
+                    return kw.value.value
+    return None
+
+
+def check(unit: ModuleUnit, ctx: RepoContext) -> list[Finding]:
+    manifest = _load_manifest(ctx)
+    if manifest is None:
+        # Only complain about the missing manifest from the file it pins,
+        # not from every linted module.
+        if any(unit.rel.endswith(k) for k in ("ops/paged_attention.py",)):
+            return [
+                Finding(
+                    RULE,
+                    unit.rel,
+                    1,
+                    f"kernel ABI manifest {MANIFEST_REL} missing or invalid",
+                )
+            ]
+        return []
+    entry = _module_entry(manifest, unit)
+    if entry is None:
+        return []
+
+    findings: list[Finding] = []
+    fns = {
+        n.name: n
+        for n in ast.walk(unit.tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    for fn_name, pin in entry.items():
+        fn = fns.get(fn_name)
+        if fn is None:
+            findings.append(
+                Finding(
+                    RULE,
+                    unit.rel,
+                    1,
+                    f"manifest pins {fn_name}() but it no longer exists",
+                )
+            )
+            continue
+        got, line = _extract_operands(fn)
+        want = list(pin.get("operands", []))
+        if got != want:
+            findings.append(
+                Finding(
+                    RULE,
+                    unit.rel,
+                    line,
+                    f"{fn_name}() operand order {got} != pinned ABI {want} "
+                    f"(update {MANIFEST_REL} only with a matching kernel-"
+                    "body *refs change)",
+                )
+            )
+        n_prefetch = _extract_prefetch_count(fn)
+        want_prefetch = pin.get("num_scalar_prefetch")
+        if want_prefetch is not None and n_prefetch != want_prefetch:
+            findings.append(
+                Finding(
+                    RULE,
+                    unit.rel,
+                    line,
+                    f"{fn_name}() num_scalar_prefetch={n_prefetch} != "
+                    f"pinned {want_prefetch} — index maps and the operand "
+                    "split both depend on it",
+                )
+            )
+    return [f for f in findings if not unit.suppressed(RULE, f.line)]

@@ -166,6 +166,7 @@ def iter_py_files(targets: Iterable[str]) -> list[Path]:
 def all_rules() -> dict[str, object]:
     """Rule name -> checker module, in deterministic order."""
     from tools.kvlint.checkers import (
+        kernel_abi,
         knob_default,
         lock_discipline,
         metric_pin,
@@ -176,6 +177,7 @@ def all_rules() -> dict[str, object]:
     mods = [
         knob_default,
         wire_append_only,
+        kernel_abi,
         metric_pin,
         lock_discipline,
         monotonic_time,
