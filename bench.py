@@ -368,10 +368,7 @@ class Pod:
                 dt = cap
         self._step_samples.append(dt)
         self.clock += dt
-        if self._unstamped:
-            for events in self._unstamped:
-                self.bus.stage(self._make_msg(events, self.clock), self.clock)
-            self._unstamped.clear()
+        self.flush_staged()
         # Record first-token virtual times (running lanes catch prefill
         # first-tokens; `done` catches sequences that finished this step).
         sched = self.engine.scheduler
@@ -391,6 +388,15 @@ class Pod:
                     seq.num_cached_prompt,
                     len(seq.prompt_tokens),
                 )
+
+    def flush_staged(self):
+        # Stage any events the engine emitted outside step() (e.g. an
+        # import_kv_blocks flush): a pod with no work never steps, so
+        # without this the index would never learn those blocks landed.
+        if self._unstamped:
+            for events in self._unstamped:
+                self.bus.stage(self._make_msg(events, self.clock), self.clock)
+            self._unstamped.clear()
 
     def advance_to(self, t, ttfts, arrivals):
         while self.engine.has_work and self.clock < t:
@@ -1111,6 +1117,555 @@ def run_policy(
         **({"staleness": staleness_detail} if staleness_detail is not None else {}),
         **({"audit": audit_detail} if audit_detail is not None else {}),
     }
+
+
+def run_fleet_arm(
+    workload, params, engine_cfg, max_pods, max_new_tokens, dynamic,
+    start_pods=None, roomy_pool=False,
+):
+    """ISSUE 17 controller arm: the same co-sim engines with POD COUNT in
+    the loop, under the PRODUCT ``FleetController`` (the real decision
+    logic — burn x MRC-headroom with hysteresis — driven by a co-sim
+    adapter whose migrate/revive actions move KV through the real engine
+    export/import endpoints). ``dynamic=False`` is the comparator: the
+    identical fleet pinned at ``max_pods`` for the whole run (the static
+    peak fleet a capacity planner would provision for the burst top).
+
+    The judged pair: the dynamic arm must hold TTFT percentiles through
+    the bursts at FEWER pod-seconds than the static peak (pod-seconds =
+    virtual provisioned time summed over pods, the bill a fleet actually
+    pays). Engines run with a pool small enough that one pod cannot hold
+    the workload's prefix working set but the full fleet can — the
+    capacity regime where the MRC gate has something to say; burn alone
+    (a compute-bound queue spike with a flat curve) correctly holds with
+    ``burning_mrc_flat``.
+
+    Scale-down live-migrates the victim's in-flight sequences through
+    the product freeze/export/import/fold path; first-token times and
+    first-prefill hit accounting stay with the sequence across the move
+    (TTFT is a property of the REQUEST, not of whichever pod finished
+    it).
+
+    ``start_pods`` overrides the dynamic arm's initial fleet width (the
+    scale-DOWN drill starts at max_pods, over-provisioned);
+    ``roomy_pool`` sizes the pool so ONE pod holds the whole working
+    set — the flat-MRC regime where ``idle_mrc_flat`` scale-down is the
+    CORRECT call (the family default is the opposite: capacity-starved,
+    where the MRC gate rightly refuses to shed warmth)."""
+    import dataclasses as _dc
+
+    from llm_d_kv_cache_manager_tpu.kvcache import (
+        KVCacheIndexer,
+        KVCacheIndexerConfig,
+        PrefixAffinityTracker,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.controller import (
+        FleetController,
+        FleetControllerConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.controller import (
+        PodSignals as FleetPodSignals,
+    )
+    from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+        ChunkedTokenDatabase,
+        TokenProcessorConfig,
+    )
+    from llm_d_kv_cache_manager_tpu.obs.lifecycle import (
+        ReuseDistanceEstimator,
+        debug_mrc_payload,
+    )
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    page = engine_cfg.block_manager.page_size
+    # Pool sizing: the fleet at max_pods holds the whole prefix working
+    # set with slack; one pod holds only a fraction of it. BENCH_FLEET_
+    # PAGES overrides.
+    prompt_pages = max(
+        -(-(len(toks) + max_new_tokens + 1) // page) for _, _, toks in workload
+    )
+    distinct = len({tuple(toks[: page * 2]) for _, _, toks in workload})
+    working = max(distinct, 2) * prompt_pages
+    if roomy_pool:
+        fleet_pages = working + prompt_pages + 1
+    else:
+        fleet_pages = int(
+            os.environ.get(
+                "BENCH_FLEET_PAGES",
+                str(max(-(-working * 2 // max_pods), prompt_pages + 3) + 1),
+            )
+        )
+    # The drill runs a longer decode tail than the family regime; widen
+    # the model length (and its page buckets) when the prompt + tail
+    # would not fit the family shape.
+    need_len = (
+        max(len(toks) for _, _, toks in workload) + max_new_tokens + page
+    )
+    mml = max(engine_cfg.max_model_len, need_len)
+    cfg = _dc.replace(
+        engine_cfg,
+        max_model_len=mml,
+        prefill_ctx_bucket=-(-mml // page),
+        decode_pages_bucket=-(-mml // page),
+        block_manager=_dc.replace(
+            engine_cfg.block_manager, total_pages=fleet_pages
+        ),
+    )
+    # The shrunken pool is a NEW kv-pool shape: compile it on a scratch
+    # engine (main()'s warmup covered the full-size pool only) so neither
+    # arm's virtual clocks eat the XLA compiles — the first arm to run
+    # would otherwise be charged seconds of compile as fake queueing.
+    longest = max((toks for _, _, toks in workload), key=len)
+    warmup(
+        params, cfg, max(len(longest) - 8, page), 8,
+        engine_cfg.model.vocab_size, max_new_tokens,
+    )
+    # Unloaded cold service time, measured on a compiled scratch engine:
+    # the TTFT objective self-grounds at 2x this (an SLO an operator
+    # would set from a capability probe, NOT from loaded samples — a
+    # threshold calibrated during a pile-up learns to call the pile-up
+    # normal).
+    from llm_d_kv_cache_manager_tpu.server.engine import Engine as _Engine
+
+    probe = _Engine(cfg, params=params)
+    probe.add_request(
+        list(longest), SamplingParams(max_new_tokens=max_new_tokens)
+    )
+    t0 = time.perf_counter()
+    probe.run_until_complete()
+    t_cold = time.perf_counter() - t0
+    del probe
+    gc.collect()
+    indexer = KVCacheIndexer(
+        KVCacheIndexerConfig(token_processor=TokenProcessorConfig(block_size=page))
+    )
+    pool, publish = make_event_pipeline(indexer.kv_block_index, max_pods)
+    lag_s = float(os.environ.get("BENCH_EVENT_LAG_MS", "2")) / 1000.0
+    bus = LaggedEventBus(pool, lag_s)
+    pods = [Pod(i, cfg, params, publish, bus) for i in range(max_pods)]
+    pod_cap = fleet_pages - 1
+    mrc_est = [
+        ReuseDistanceEstimator(sample_rate=1.0, max_tracked=1 << 15)
+        for _ in pods
+    ]
+    for p, est in zip(pods, mrc_est):
+        p.engine.block_manager.attach_lifecycle(None, est)
+    aff = PrefixAffinityTracker(
+        max_pods,
+        capacity_blocks=pod_cap,
+        token_processor=ChunkedTokenDatabase(TokenProcessorConfig(block_size=page)),
+    )
+    link_bytes_s = float(os.environ.get("BENCH_TRANSFER_GBPS", "10")) * 1e9 / 8
+
+    # THE PRODUCT ROUTER over the active subset, WITH the transfer cost
+    # model. The pull arm matters more here than in the pinned-width
+    # arms: score-max pins each prefix group on the one pod that is warm
+    # for it (load only breaks score ties), so after a scale-up the old
+    # pod would keep thrashing its pool on every group it seeded while
+    # the new pods idle — the cost model is what MOVES warmth to where
+    # the headroom is. BlendedRouter ranks candidates positionally; the
+    # shim maps positions back to global pod slots as the active set
+    # changes per arrival.
+    from llm_d_kv_cache_manager_tpu.kvcache import BlendedRouter
+    from llm_d_kv_cache_manager_tpu.kvcache.transfer import (
+        TransferCostModel,
+        TransferCostModelConfig,
+    )
+
+    class _ActiveAff:
+        order: list = []
+
+        @staticmethod
+        def keys(tokens):
+            return aff.keys(tokens)
+
+        @staticmethod
+        def score(keys, i, now):
+            return aff.score(keys, _ActiveAff.order[i], now)
+
+        @staticmethod
+        def record(keys, i, now):
+            aff.record(keys, _ActiveAff.order[i], now)
+
+    cost_model = TransferCostModel(
+        TransferCostModelConfig(
+            block_bytes=pods[0].engine.kv_block_bytes, block_size=page
+        )
+    )
+    cost_model.seed_rates(transfer_bytes_s=link_bytes_s)
+    blended = BlendedRouter(
+        score_fn=lambda toks, names: indexer.score_tokens(
+            toks, MODEL_NAME, names
+        ),
+        affinity=_ActiveAff,
+        loads_fn=lambda names: [
+            pods[int(nm.rsplit("-", 1)[1])].load for nm in names
+        ],
+        cost_model=cost_model,
+    )
+    pull_stats = {"pulls": 0, "pulled_blocks": 0, "pull_s": 0.0}
+
+    ttfts: dict[int, float] = {}
+    arrivals: dict[int, float] = {}
+    segments: dict[int, int] = {}
+    vnow = [0.0]
+    n0 = (
+        max_pods
+        if not dynamic
+        else (start_pods if start_pods is not None else 1)
+    )
+    active: set[int] = set(range(n0))
+    retired: set[int] = set()
+    span_start = {i: 0.0 for i in active}
+    pod_seconds = [0.0]
+    live: dict[str, tuple[int, object]] = {}  # request_id -> (pod idx, seq)
+    actions: list[dict] = []
+    peak_pods = [len(active)]
+    migrations = {"migrated": 0, "migrated_blocks": 0, "revived_blocks": 0}
+    # Measured wall time of the migration path (freeze/export/import +
+    # modeled link), summed over migrations: the acceptance comparison
+    # against the 30 s DRAIN_TIMEOUT_S a drain-based removal pays.
+    migrate_wall = [0.0]
+
+    # SLO-burn signal on the virtual clock: objective "TTFT <= T at p90"
+    # where T self-calibrates to 2x the median of the first completions
+    # (the co-sim has no absolute latency scale across rigs); burn =
+    # windowed miss fraction / the 10% error budget — the same burn-rate
+    # definition obs/slo.py exports as kvcache_slo_burn_rate.
+    span_t = workload[-1][0] if workload else 1.0
+    rec_interval = max(span_t / 60.0, 1e-3)
+    burn_window = 8 * rec_interval
+    samples: list[tuple[float, float]] = []  # (first-token instant, ttft)
+    seen_first: set[int] = set()
+    slo_t = float(
+        os.environ.get("BENCH_FLEET_SLO_TTFT_S", "") or 2.0 * t_cold
+    )
+
+    def harvest():
+        for p in pods:
+            for sid, ft in p.first_clock.items():
+                if sid in seen_first or sid not in ttfts:
+                    continue
+                seen_first.add(sid)
+                samples.append((ft, ttfts[sid]))
+
+    def burn_rates_now():
+        recent = [v for ft, v in samples if ft >= vnow[0] - burn_window]
+        # Overdue-in-queue requests count as misses NOW: a saturated pod
+        # delays its own first tokens, so a burn signal built only from
+        # REALIZED TTFTs goes quiet exactly when the fleet is drowning —
+        # the alarm must fire while the queue is growing, not after it
+        # drains.
+        overdue = sum(
+            1
+            for sid, at in arrivals.items()
+            if sid not in seen_first and vnow[0] - at > slo_t
+        )
+        if not recent and not overdue:
+            return None
+        miss = (sum(1 for v in recent if v > slo_t) + overdue) / (
+            len(recent) + overdue
+        )
+        return {"ttft_bench_p0.9": {"w": miss / 0.1}}
+
+    class CosimFleet:
+        """FleetAdapter over the co-sim pods (indices name endpoints)."""
+
+        def observe(self):
+            burn = burn_rates_now()
+            out = []
+            for i in sorted(active):
+                out.append(
+                    FleetPodSignals(
+                        pod_id=f"tpu-pod-{i}",
+                        transfer_endpoint=str(i),
+                        capacity_blocks=pod_cap,
+                        burn_rates=burn,
+                        mrc=debug_mrc_payload(mrc_est[i]),
+                        live_requests=[
+                            rid
+                            for rid, (pi, s) in live.items()
+                            if pi == i and not s.is_finished()
+                        ],
+                    )
+                )
+            return out
+
+        def add_pod(self):
+            idx = next(
+                (
+                    i
+                    for i in range(max_pods)
+                    if i not in active and i not in retired
+                ),
+                None,
+            )
+            if idx is None:
+                return None
+            active.add(idx)
+            peak_pods[0] = max(peak_pods[0], len(active))
+            span_start[idx] = vnow[0]
+            pods[idx].clock = max(pods[idx].clock, vnow[0])
+            return FleetPodSignals(
+                pod_id=f"tpu-pod-{idx}",
+                transfer_endpoint=str(idx),
+                capacity_blocks=pod_cap,
+            )
+
+        def migrate(self, pod_id, request_id, target_endpoint):
+            src = pods[int(pod_id.rsplit("-", 1)[1])]
+            tgt = pods[int(target_endpoint)]
+            frozen = src.engine.freeze_for_migration(request_id)
+            if frozen is None:
+                return False
+            seq, hashes = frozen
+            t0 = time.perf_counter()
+            blocks = src.engine.export_kv_blocks(hashes)
+            n_imp = tgt.engine.import_kv_blocks(blocks)
+            wall = time.perf_counter() - t0
+            wire = sum(b.wire_bytes for b in blocks)
+            link_s = wire / link_bytes_s if link_bytes_s else 0.0
+            tgt.clock = max(tgt.clock, vnow[0]) + wall + link_s
+            migrate_wall[0] += wall + link_s
+            cont = tgt.engine.add_request(
+                list(seq.prompt_tokens),
+                SamplingParams(max_new_tokens=seq.sampling.max_new_tokens),
+                request_id=request_id,
+            )
+            cont.user_prompt_len = seq.user_prompt_len
+            cont.num_generated = seq.num_generated
+            src.engine.finish_migrated(seq)
+            src.flush_staged()
+            tgt.flush_staged()
+            old, new = seq.seq_id, cont.seq_id
+            for d in (arrivals, segments):
+                if old in d:
+                    d[new] = d.pop(old)
+            if old in ttfts:
+                # First token already served at the source: the TTFT (and
+                # the first-prefill hit snapshot) is settled history — the
+                # continuation must not re-record either, and the burn
+                # signal's overdue scan must not see a served request as
+                # still queued under its new seq_id.
+                ttfts[new] = ttfts.pop(old)
+                tgt._first_token_seen.add(new)
+                seen_first.add(new)
+                if old in src.hit_stats:
+                    tgt.hit_stats[new] = src.hit_stats[old]
+            tgt.seqs.append(cont)
+            live[request_id] = (int(target_endpoint), cont)
+            migrations["migrated"] += 1
+            migrations["migrated_blocks"] += n_imp
+            return True
+
+        def retire(self, pod_id):
+            idx = int(pod_id.rsplit("-", 1)[1])
+            active.discard(idx)
+            retired.add(idx)
+            # Migration fallbacks (none expected) finish locally before
+            # the pod is deprovisioned; the straggler time is billed.
+            pods[idx].drain(ttfts, arrivals)
+            end = max(vnow[0], pods[idx].clock)
+            pod_seconds[0] += end - span_start.pop(idx)
+
+        def warm_sets(self, limit):
+            rows = []
+            for i in sorted(active):
+                for chain in pods[i].engine.block_manager.hot_chains(limit):
+                    rows.append((str(i), chain))
+            rows.sort(key=lambda r: len(r[1]), reverse=True)
+            return rows[:limit]
+
+        def revive(self, pod_id, source_endpoint, chain_hashes):
+            tgt = pods[int(pod_id.rsplit("-", 1)[1])]
+            src = pods[int(source_endpoint)]
+            t0 = time.perf_counter()
+            blocks = src.engine.export_kv_blocks(chain_hashes)
+            n_imp = tgt.engine.import_kv_blocks(blocks)
+            wall = time.perf_counter() - t0
+            wire = sum(b.wire_bytes for b in blocks)
+            tgt.clock = max(tgt.clock, vnow[0]) + wall + (
+                wire / link_bytes_s if link_bytes_s else 0.0
+            )
+            # The revived pod has no work yet, so it will not step: stage
+            # the import's BlockStored events now or the index never sees
+            # the revival and routing never warms to the new pod.
+            tgt.flush_staged()
+            migrations["revived_blocks"] += n_imp
+            return n_imp
+
+    ctl = None
+    if dynamic:
+        ctl = FleetController(
+            FleetControllerConfig(
+                enabled=True,
+                reconcile_interval_s=rec_interval,
+                burn_threshold=float(
+                    os.environ.get("BENCH_FLEET_BURN", "") or "1.5"
+                ),
+                mrc_headroom=float(
+                    os.environ.get("BENCH_FLEET_HEADROOM", "") or "0.01"
+                ),
+                hysteresis_s=2 * rec_interval,
+                min_pods=1,
+                max_pods=max_pods,
+            ),
+            CosimFleet(),
+            clock=lambda: vnow[0],
+        )
+
+    next_rec = rec_interval
+    for req_i, (t, seg, tokens) in enumerate(workload):
+        if ctl is not None:
+            while next_rec <= t:
+                for i in sorted(active):
+                    pods[i].advance_to(next_rec, ttfts, arrivals)
+                vnow[0] = next_rec
+                harvest()
+                d = ctl.reconcile()
+                if d.action != "hold":
+                    actions.append({"t": round(next_rec, 3), **d.as_attrs()})
+                next_rec += rec_interval
+        for i in sorted(active):
+            pods[i].advance_to(t, ttfts, arrivals)
+        vnow[0] = t
+        # Release in-flight events so the index reflects fleet state at
+        # the arrival instant — including the BlockStored batch from a
+        # warm-set revival, which is what makes a freshly added pod
+        # attract its share of the working set (the index SEES the
+        # revived chains). Routing and the pull arm mirror run_policy's
+        # precise+transfer path over the active subset.
+        bus.release(t)
+        order = sorted(active)
+        names = [f"tpu-pod-{i}" for i in order]
+        _ActiveAff.order = order
+        rates = [
+            pods[i].engine._prefill_rate
+            for i in order
+            if pods[i].engine._prefill_rate
+        ]
+        if rates:
+            cost_model.seed_rates(prefill_tokens_s=float(np.median(rates)))
+        decision = blended.route(tokens, names, now=t, request_id=f"r{req_i}")
+        best = int(decision.pod.rsplit("-", 1)[1])
+        if decision.action == "pull" and decision.pull_source is not None:
+            tgt = pods[best]
+            src = pods[int(decision.pull_source.rsplit("-", 1)[1])]
+            hashes = indexer.token_processor.prefix_hashes(tokens)
+            t0p = time.perf_counter()
+            blocks = src.engine.export_kv_blocks(hashes)
+            n_imp = tgt.engine.import_kv_blocks(blocks)
+            wallp = time.perf_counter() - t0p
+            wire = sum(b.wire_bytes for b in blocks)
+            link_s = wire / link_bytes_s if wire and link_bytes_s else 0.0
+            tgt.clock = max(tgt.clock, t) + wallp + link_s
+            if wire:
+                cost_model.observe_transfer(wire, wallp + link_s)
+            tgt.flush_staged()
+            pull_stats["pulls"] += 1
+            pull_stats["pulled_blocks"] += n_imp
+            pull_stats["pull_s"] += wallp + link_s
+        pod = pods[best]
+        if not pod.engine.has_work:
+            pod.clock = max(pod.clock, t)
+        seq = pod.engine.add_request(
+            tokens,
+            SamplingParams(max_new_tokens=max_new_tokens),
+            request_id=f"r{req_i}",
+        )
+        pod.seqs.append(seq)
+        arrivals[seq.seq_id] = t
+        segments[seq.seq_id] = seg
+        live[f"r{req_i}"] = (best, seq)
+    if ctl is not None:
+        # Keep reconciling through the decode tail: arrivals stopped, the
+        # burn signal goes calm, the curve flattens — the controller
+        # scales the fleet back down, LIVE-MIGRATING in-flight decodes to
+        # survivors (the scale-down path the pod-seconds bill rewards).
+        for _ in range(100_000):
+            if not any(pods[i].engine.has_work for i in active):
+                break
+            for i in sorted(active):
+                pods[i].advance_to(next_rec, ttfts, arrivals)
+            vnow[0] = max(next_rec, vnow[0])
+            harvest()
+            d = ctl.reconcile()
+            if d.action != "hold":
+                actions.append({"t": round(next_rec, 3), **d.as_attrs()})
+            next_rec += rec_interval
+        else:
+            raise RuntimeError("fleet arm failed to drain")
+    for i in sorted(active):
+        pods[i].drain(ttfts, arrivals)
+    bus.flush_all()
+    pool.drain(timeout=10.0)
+    pool.shutdown()
+    indexer.shutdown()
+
+    n_req = len(workload)
+    assert len(ttfts) == n_req, f"lost requests: {len(ttfts)}/{n_req}"
+    makespan = max(p.clock for p in pods)
+    for idx, start in span_start.items():
+        pod_seconds[0] += max(makespan, vnow[0]) - start
+    prompt_tokens = sum(n for p in pods for _, n in p.hit_stats.values())
+    cached_tokens = sum(c for p in pods for c, _ in p.hit_stats.values())
+    all_ttfts = np.asarray(list(ttfts.values()))
+    # Per-QPS-segment tails: reactive autoscaling concedes the FIRST
+    # spike (detection needs samples), then holds the repeats — the
+    # segment columns are where that shows.
+    n_segments = max(segments.values()) + 1
+    seg_p99 = [
+        round(
+            float(
+                np.percentile(
+                    [ttfts[sid] for sid, s in segments.items() if s == seg],
+                    99,
+                )
+            ),
+            4,
+        )
+        if any(s == seg for s in segments.values())
+        else None
+        for seg in range(n_segments)
+    ]
+    itls = np.asarray(
+        [
+            (p.finish_clock[s.seq_id] - p.first_clock[s.seq_id])
+            / (s.num_generated - 1)
+            for p in pods
+            for s in p.seqs
+            if s.num_generated > 1
+            and s.seq_id in p.first_clock
+            and s.seq_id in p.finish_clock
+        ]
+    )
+    out = {
+        "p50_ttft_s": float(np.median(all_ttfts)),
+        "p90_ttft_s": float(np.percentile(all_ttfts, 90)),
+        "p99_ttft_s": float(np.percentile(all_ttfts, 99)),
+        "p50_itl_s": float(np.median(itls)) if itls.size else None,
+        "p99_itl_s": float(np.percentile(itls, 99)) if itls.size else None,
+        "prefix_cache_hit_rate": (
+            float(cached_tokens / prompt_tokens) if prompt_tokens else 0.0
+        ),
+        "makespan_s": float(makespan),
+        "seg_p99_ttft_s": seg_p99,
+        "pod_seconds": round(pod_seconds[0], 3),
+        "peak_pods": peak_pods[0],
+        "pod_pages": fleet_pages,
+        "slo_ttft_s": round(slo_t, 4),
+        "cold_service_s": round(t_cold, 4),
+        **migrations,
+        "migration_wall_s": round(migrate_wall[0], 4),
+        "pulls": pull_stats["pulls"],
+        "pulled_blocks": pull_stats["pulled_blocks"],
+        "pull_s": round(pull_stats["pull_s"], 4),
+    }
+    if dynamic:
+        out["actions"] = actions
+        out["decisions"] = len(ctl.decisions)
+    pods.clear()
+    gc.collect()
+    return out
 
 
 def run_disagg(
@@ -1940,6 +2495,113 @@ def main() -> int:
             if spread_pol:
                 family_spreads[wname] = spread_pol
 
+    # -- Fleet controller arm (ISSUE 17): pod count in the loop ----------
+    # The family re-judged as an AUTOSCALING problem: the same four
+    # traffic shapes served twice on identical capacity-constrained
+    # engines — once by a fleet pinned at the burst peak (what a planner
+    # provisions statically), once starting at one pod under the product
+    # FleetController (scale-up on burn x MRC headroom with warm-set
+    # revival, scale-down by live migration). The verdict column is
+    # pod-seconds at comparable tail latency.
+    fleet_detail = None
+    if (
+        os.environ.get("BENCH_FLEET", "1") == "1"
+        and family_results is not None
+    ):
+        fleet_detail = {}
+        # The family runs at fam_qps (rates scaled UP by fam_pods/n_pods
+        # so a pinned fam_pods fleet saturates — right for comparing
+        # routing policies at fixed width, wrong for autoscaling, where
+        # the premise is a quiet baseline ONE pod can carry and bursts
+        # only the peak fleet can). Dilate arrivals back to the n_pods-
+        # calibrated rate — identical request mix and shape, segment
+        # durations long relative to the reconcile cadence (the real-
+        # world analogue: minutes-long traffic shifts vs a seconds-scale
+        # reconcile loop). Both arms see the same schedule.
+        dil = fam_pods / n_pods
+
+        def fleet_med(rolls):
+            # Per-metric MEDIANS over the BENCH_REPEATS rolls (CPU-smoke
+            # wall-clock jitter between identical runs is large; a
+            # single draw can eat a 1 s stall in one segment). The last
+            # roll's full dict carries the non-judged color (actions,
+            # pulls, revived counts); seg tails median element-wise.
+            out = dict(rolls[-1])
+            for k in (
+                "p50_ttft_s", "p90_ttft_s", "p99_ttft_s", "makespan_s",
+                "pod_seconds", "prefix_cache_hit_rate", "migration_wall_s",
+            ):
+                out[k] = round(float(np.median([r[k] for r in rolls])), 4)
+            out["migrated"] = int(np.median([r["migrated"] for r in rolls]))
+            segs = [r["seg_p99_ttft_s"] for r in rolls]
+            out["seg_p99_ttft_s"] = [
+                round(float(np.median([s[j] for s in segs])), 4)
+                for j in range(len(segs[0]))
+            ]
+            out["peak_pods"] = max(r["peak_pods"] for r in rolls)
+            return out
+
+        for wname, wl in fam_workloads.items():
+            wl = [(t * dil, seg, toks) for t, seg, toks in wl]
+            static = fleet_med(
+                [
+                    run_fleet_arm(
+                        wl, params, engine_cfg, fam_pods, max_new,
+                        dynamic=False,
+                    )
+                    for _ in range(fam_repeats)
+                ]
+            )
+            dyn = fleet_med(
+                [
+                    run_fleet_arm(
+                        wl, params, engine_cfg, fam_pods, max_new,
+                        dynamic=True,
+                    )
+                    for _ in range(fam_repeats)
+                ]
+            )
+            fleet_detail[wname] = {
+                "static_peak": static,
+                "controller": dyn,
+                "pod_seconds_saved_pct": (
+                    round(
+                        100.0
+                        * (static["pod_seconds"] - dyn["pod_seconds"])
+                        / static["pod_seconds"],
+                        2,
+                    )
+                    if static["pod_seconds"]
+                    else None
+                ),
+            }
+        # Scale-DOWN drill (the acceptance's "well under DRAIN_TIMEOUT_S,
+        # measured in the bench"): start OVER-provisioned (all pods up)
+        # with a roomy pool (one pod holds the whole working set, so the
+        # aggregate MRC is flat at reduced capacity — `idle_mrc_flat` is
+        # the correct call) on a SHORT quiet workload whose decode tails
+        # outlive the arrivals. Once traffic ends the controller sheds
+        # pods, LIVE-MIGRATING the victims' in-flight decodes;
+        # migration_wall_s is the measured freeze/export/import + link
+        # time where a drain-based removal waits out DRAIN_TIMEOUT_S
+        # (30 s default) per pod. Deliberately NOT a burst arm: with a
+        # flat curve the decision table holds on burn (burning_mrc_flat
+        # — capacity is not the bottleneck), so bursts would judge the
+        # routing regime, not the scale-down path under test here.
+        drill_wl = [
+            (t * dil, seg, toks) for t, seg, toks in fam_workloads["burst"]
+        ][: max(2 * fam_pods, 8)]
+        fleet_detail["scaledown_drill"] = fleet_med(
+            [
+                run_fleet_arm(
+                    drill_wl, params, engine_cfg, fam_pods,
+                    max(max_new, 32), dynamic=True,
+                    start_pods=fam_pods, roomy_pool=True,
+                )
+                for _ in range(fam_repeats)
+            ]
+        )
+
     # Headline metrics are precise-vs-round_robin by definition: when a
     # BENCH_POLICIES subset omits either, the corresponding fields are
     # null rather than silently reporting another policy's numbers.
@@ -1990,6 +2652,7 @@ def main() -> int:
         "disagg": disagg_result,
         "workload_family": family_results,
         "workload_family_spread": family_spreads,
+        "fleet_controller": fleet_detail,
     }
     print(json.dumps(detail), file=sys.stderr)
 
@@ -2358,6 +3021,58 @@ def main() -> int:
                 # burst+ramp acceptance verdicts, and the latency
                 # model's realized/predicted honesty median.
                 "workload_family": fam_headline,
+                # Fleet-controller headline (ISSUE 17; null unless the
+                # BENCH_FLEET pass ran): per-shape controller-vs-static-
+                # peak pod-seconds and p99 TTFT, plus the controller's
+                # action log sizes — the autoscaling verdict columns.
+                "fleet_controller": (
+                    {
+                        wname: {
+                            # The scale-down drill is a single dynamic
+                            # arm (no static comparator): its verdict
+                            # columns are the shed/migration measurements.
+                            "scale_actions": len(row.get("actions", [])),
+                            "pods_shed": sum(
+                                1
+                                for a in row.get("actions", [])
+                                if a["action"] == "scale_down"
+                            ),
+                            "migrated": row["migrated"],
+                            "migration_wall_s": row["migration_wall_s"],
+                            "p99_ttft_s": round(row["p99_ttft_s"], 4),
+                            "pod_seconds": row["pod_seconds"],
+                        }
+                        if "static_peak" not in row
+                        else {
+                            "static_p99_ttft_s": round(
+                                row["static_peak"]["p99_ttft_s"], 4
+                            ),
+                            "controller_p99_ttft_s": round(
+                                row["controller"]["p99_ttft_s"], 4
+                            ),
+                            "static_pod_seconds": row["static_peak"][
+                                "pod_seconds"
+                            ],
+                            "controller_pod_seconds": row["controller"][
+                                "pod_seconds"
+                            ],
+                            "pod_seconds_saved_pct": row[
+                                "pod_seconds_saved_pct"
+                            ],
+                            "peak_pods": row["controller"]["peak_pods"],
+                            "scale_actions": len(
+                                row["controller"].get("actions", [])
+                            ),
+                            "migrated": row["controller"]["migrated"],
+                            "revived_blocks": row["controller"][
+                                "revived_blocks"
+                            ],
+                        }
+                        for wname, row in fleet_detail.items()
+                    }
+                    if fleet_detail
+                    else None
+                ),
             }
         )
     )

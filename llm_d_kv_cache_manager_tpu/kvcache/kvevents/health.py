@@ -104,6 +104,9 @@ class FleetHealth:
         self.publisher_drops_reported = 0  # guarded_by: _mu
         self.pods_drained = 0  # guarded_by: _mu
         self.prefills_completed = 0  # guarded_by: _mu
+        #: fleet-controller membership changes (observe_pod_added/_removed)
+        self.pods_added = 0  # guarded_by: _mu
+        self.pods_removed = 0  # guarded_by: _mu
         #: sticky "a kvstore role has ever been advertised" latch: lets the
         #: role-blind (placement=None) filter keep its zero-lookup fast
         #: path on fleets with no remote tier — the overwhelmingly common
@@ -240,6 +243,31 @@ class FleetHealth:
         collector.bump("fleet_pods_drained")
         collector.fleet_pods_drained.inc()
         log.warning("pod drained; evicted from routing immediately", pod=pod)
+
+    # -- fleet-controller membership (kvcache/controller) -------------------
+    def observe_pod_added(self, pod: str) -> None:
+        """A fleet-controller scale-up provisioned this pod: register it
+        live immediately, so routing can count on it before its first
+        heartbeat lands (a cold TTL wait on a pod the controller just
+        revived warm would waste exactly the revival)."""
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.last_seen = self._clock()
+            st.swept = False
+            st.drained = False
+            st.draining = False
+            self.pods_added += 1
+
+    def observe_pod_removed(self, pod: str) -> None:
+        """A fleet-controller scale-down is retiring this pod: unroute it
+        NOW, before its drain even starts — the live migrations moving its
+        sequences must not race fresh placements onto the victim. The
+        ``PodDrained`` goodbye (or the TTL) finishes the eviction."""
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.last_seen = self._clock()
+            st.draining = True
+            self.pods_removed += 1
 
     def observe_prefill_complete(self, pod: str) -> None:
         """A ``PrefillComplete`` event: a prefill-role pod finished a
@@ -486,6 +514,16 @@ class FleetHealth:
                 **(
                     {"prefills_completed": self.prefills_completed}
                     if self.prefills_completed
+                    else {}
+                ),
+                # Same rule: keys appear only once a fleet controller has
+                # actually resized the fleet.
+                **(
+                    {"pods_added": self.pods_added} if self.pods_added else {}
+                ),
+                **(
+                    {"pods_removed": self.pods_removed}
+                    if self.pods_removed
                     else {}
                 ),
                 "pods": pods,

@@ -30,10 +30,15 @@ from .client import (
 from .cost_model import TransferCostModel, TransferCostModelConfig
 from .protocol import (
     BlockPayload,
+    MigrationPayload,
+    decode_migrate,
+    decode_migrate_ack,
     decode_push,
     decode_push_ack,
     decode_request,
     decode_response,
+    encode_migrate,
+    encode_migrate_ack,
     encode_push,
     encode_push_ack,
     encode_request,
@@ -47,6 +52,7 @@ __all__ = [
     "CircuitBreaker",
     "KVTransferClient",
     "KVTransferService",
+    "MigrationPayload",
     "RemoteBlockStore",
     "RemoteStoreConfig",
     "TransferClientConfig",
@@ -55,6 +61,10 @@ __all__ = [
     "TransferCostModelConfig",
     "TransferError",
     "TransferServiceConfig",
+    "decode_migrate",
+    "decode_migrate_ack",
+    "encode_migrate",
+    "encode_migrate_ack",
     "decode_push",
     "decode_push_ack",
     "decode_request",

@@ -36,8 +36,11 @@ from ...utils import get_logger
 from ..metrics import collector
 from .protocol import (
     BlockPayload,
+    MigrationPayload,
+    decode_migrate_ack,
     decode_push_ack,
     decode_response,
+    encode_migrate,
     encode_push,
     encode_request,
 )
@@ -327,6 +330,58 @@ class KVTransferClient:
                 sum(b.wire_bytes for b in blocks[:accepted]), dt
             )
         return accepted, headroom
+
+    def migrate(
+        self,
+        model_name: str,
+        source_pod: str,
+        migration: MigrationPayload,
+        timeout_s: Optional[float] = None,
+    ) -> tuple[int, bool]:
+        """Live migration: ship one frozen in-flight decode sequence
+        (state + KV chain) to the peer. Returns ``(accepted_blocks,
+        resumed)`` from the ack; raises ``TransferError`` on
+        timeout/refusal and returns ``resumed=False`` on a polite
+        decline — either way the caller's fallback is resuming the
+        sequence locally via cold recompute, exactly the no-migration
+        outcome. Shares the fetch path's socket, lock, breaker, and
+        teardown discipline."""
+        if self.breaker is not None and not self.breaker.allow():
+            self.breaker_skips += 1
+            raise TransferError(
+                f"circuit open for {self.config.endpoint} "
+                f"(skipping migrate; local resume)"
+            )
+        try:
+            reply, dt = self._request_reply(
+                encode_migrate(model_name, source_pod, migration),
+                timeout_s,
+                kind="migrate",
+            )
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        decoded = decode_migrate_ack(reply)
+        if decoded is None:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise TransferError("undecodable migrate ack")
+        accepted, resumed, error = decoded
+        if error is not None:
+            # A refusal (legacy peer, controller off, model mismatch) is
+            # a protocol-level answer from a LIVE peer: settle the
+            # breaker closed, same reasoning as push refusals.
+            if self.breaker is not None:
+                self.breaker.record_success()
+            raise TransferError(f"peer refused migrate: {error}")
+        if self.breaker is not None:
+            self.breaker.record_success()
+        if self.on_sample is not None and accepted:
+            self.on_sample(
+                sum(b.wire_bytes for b in migration.blocks[:accepted]), dt
+            )
+        return accepted, resumed
 
     def _request_reply(
         self, payload: bytes, timeout_s: Optional[float], kind: str

@@ -32,6 +32,22 @@ pusher treats as "fall back to plain eviction"):
   committed to its remote store, and how many more pages it will take
   (the pusher's per-peer headroom feed between heartbeats).
 
+Live-migration extension (``FLEET_CONTROLLER``; never on the wire unless
+the controller migrates a sequence, so default traffic is bit-identical
+and old services answer the unknown tag with a tolerant ``TransferError``
+the source treats as "fall back to local cold recompute"):
+
+- migrate: ``["MigrateSeq", model_name, source_pod, request_id,
+  token_ids, user_prompt_len, num_generated, [max_new_tokens,
+  temperature, top_k, top_p, stop_token_ids], deadline_remaining_s,
+  [block, ...]]`` — one frozen in-flight decode sequence: its full token
+  history (the continuation prompt), generation bookkeeping, sampling
+  state, remaining deadline budget, and the KV chain backing it (block
+  rows reuse the ``Blocks`` encoding, quant triple included).
+- ack: ``["MigrateAck", accepted, resumed]`` — how many chain blocks the
+  target installed and whether it admitted the continuation; ``resumed``
+  False means the source must resume the sequence locally.
+
 Hashes are uint64 (the sha256-CBOR chain the whole system keys on); page
 payloads ride as raw bytes of the engine's ``[n_layers, page_size,
 n_kv_heads, head_dim]`` page slice, dtype/shape-tagged so the importer can
@@ -40,7 +56,7 @@ verify geometry before committing anything.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
 import msgpack
@@ -50,6 +66,8 @@ BLOCKS_TAG = "Blocks"
 ERROR_TAG = "TransferError"
 PUSH_BLOCKS_TAG = "PushBlocks"
 PUSH_ACK_TAG = "PushAck"
+MIGRATE_SEQ_TAG = "MigrateSeq"
+MIGRATE_ACK_TAG = "MigrateAck"
 
 
 @dataclass
@@ -290,6 +308,157 @@ def decode_push_ack(
         return None
     try:
         return int(arr[1]), int(arr[2]), None
+    except (TypeError, ValueError):
+        return None
+
+
+@dataclass
+class MigrationPayload:
+    """One in-flight decode sequence in transit: identity, decode state,
+    and the KV chain backing it. ``token_ids`` is the FULL token history
+    (prompt + generated so far) — on the target it becomes the
+    continuation prompt, whose prefill cache-hits the imported chain, so
+    greedy decode resumes from exactly the frozen context."""
+
+    request_id: str
+    token_ids: list[int]
+    user_prompt_len: int
+    num_generated: int
+    #: frozen sampling state (the migrated sequence's "sampling key")
+    max_new_tokens: int
+    temperature: float
+    top_k: int
+    top_p: float
+    stop_token_ids: tuple[int, ...]
+    #: seconds of request-deadline budget left at freeze; None = none set.
+    deadline_remaining_s: Optional[float]
+    blocks: list[BlockPayload] = field(default_factory=list)
+
+    @property
+    def wire_bytes(self) -> int:
+        return sum(b.wire_bytes for b in self.blocks)
+
+
+def encode_migrate(
+    model_name: str, source_pod: str, m: MigrationPayload
+) -> bytes:
+    """Live-migration request: move one frozen decode sequence (state +
+    KV chain) to the target pod, which resumes it mid-generation."""
+    arr: list = [
+        MIGRATE_SEQ_TAG,
+        model_name,
+        source_pod,
+        m.request_id,
+        [int(t) for t in m.token_ids],
+        int(m.user_prompt_len),
+        int(m.num_generated),
+        [
+            int(m.max_new_tokens),
+            float(m.temperature),
+            int(m.top_k),
+            float(m.top_p),
+            [int(t) for t in m.stop_token_ids],
+        ],
+        m.deadline_remaining_s,
+        [encode_block_row(b) for b in m.blocks],
+    ]
+    return msgpack.packb(arr, use_bin_type=True)
+
+
+def decode_migrate(
+    payload: bytes,
+) -> Optional[tuple[str, str, MigrationPayload]]:
+    """``(model_name, source_pod, migration)`` or None for
+    non-migrate/garbage frames (tried after ``decode_request`` and
+    ``decode_push``; a frame no decoder accepts answers with a tolerant
+    error, never a crash)."""
+    arr = _unpack(payload)
+    if (
+        not isinstance(arr, (list, tuple))
+        or len(arr) < 10
+        or _text(arr[0]) != MIGRATE_SEQ_TAG
+        or not isinstance(arr[4], (list, tuple))
+        or not isinstance(arr[7], (list, tuple))
+        or len(arr[7]) < 5
+        or not isinstance(arr[9], (list, tuple))
+    ):
+        return None
+    model = _text(arr[1])
+    source = _text(arr[2])
+    request_id = _text(arr[3])
+    if (
+        not isinstance(model, str)
+        or not model
+        or not isinstance(source, str)
+        or not isinstance(request_id, str)
+        or not request_id
+    ):
+        return None
+    samp = arr[7]
+    try:
+        token_ids = [int(t) for t in arr[4]]
+        user_prompt_len = int(arr[5])
+        num_generated = int(arr[6])
+        max_new_tokens = int(samp[0])
+        temperature = float(samp[1])
+        top_k = int(samp[2])
+        top_p = float(samp[3])
+        stop_token_ids = tuple(int(t) for t in (samp[4] or ()))
+    except (TypeError, ValueError):
+        return None
+    deadline_remaining_s = arr[8]
+    if deadline_remaining_s is not None:
+        try:
+            deadline_remaining_s = float(deadline_remaining_s)
+        except (TypeError, ValueError):
+            return None
+    blocks: list[BlockPayload] = []
+    for raw in arr[9]:
+        blk = _decode_block(raw)
+        if blk is None:
+            return None  # a half-garbled block corrupts the chain: reject all
+        blocks.append(blk)
+    return (
+        model,
+        source,
+        MigrationPayload(
+            request_id=request_id,
+            token_ids=token_ids,
+            user_prompt_len=user_prompt_len,
+            num_generated=num_generated,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            stop_token_ids=stop_token_ids,
+            deadline_remaining_s=deadline_remaining_s,
+            blocks=blocks,
+        ),
+    )
+
+
+def encode_migrate_ack(accepted: int, resumed: bool) -> bytes:
+    return msgpack.packb(
+        [MIGRATE_ACK_TAG, int(accepted), bool(resumed)], use_bin_type=True
+    )
+
+
+def decode_migrate_ack(
+    payload: bytes,
+) -> Optional[tuple[int, bool, Optional[str]]]:
+    """``(accepted, resumed, error)``; ``error`` set for service-side
+    refusals (including legacy services that do not speak the migrate
+    op), None return for undecodable payloads."""
+    arr = _unpack(payload)
+    if not isinstance(arr, (list, tuple)) or not arr:
+        return None
+    tag = _text(arr[0])
+    if tag == ERROR_TAG:
+        return 0, False, _text(arr[1]) if len(arr) > 1 else "unknown error"
+    if tag != MIGRATE_ACK_TAG or len(arr) < 3:
+        return None
+    try:
+        return int(arr[1]), bool(arr[2]), None
     except (TypeError, ValueError):
         return None
 

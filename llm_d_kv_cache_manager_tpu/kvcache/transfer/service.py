@@ -24,9 +24,12 @@ from typing import Callable, Optional, Sequence
 from ...utils import get_logger
 from .protocol import (
     BlockPayload,
+    MigrationPayload,
+    decode_migrate,
     decode_push,
     decode_request,
     encode_error,
+    encode_migrate_ack,
     encode_push_ack,
     encode_response,
 )
@@ -58,6 +61,9 @@ class KVTransferService:
         push_handler: Optional[
             Callable[[str, list[BlockPayload]], tuple[int, int]]
         ] = None,
+        migrate_handler: Optional[
+            Callable[[str, MigrationPayload], tuple[int, bool]]
+        ] = None,
     ):
         """``tracer`` (an ``obs.Tracer``, optional): when tracing is on,
         each served fetch records a ``transfer.export`` span, parented on
@@ -67,11 +73,17 @@ class KVTransferService:
         optional): accepts remote-tier demotion pushes into this pod's
         remote store. None (default, ``REMOTE_TIER`` off) answers pushes
         with a tolerant error the pusher treats as "fall back to plain
-        eviction" — exactly what a legacy service does."""
+        eviction" — exactly what a legacy service does.
+        ``migrate_handler`` (``(source_pod, migration) -> (accepted,
+        resumed)``, optional): accepts live-migrated in-flight decode
+        sequences. None (default, ``FLEET_CONTROLLER`` off) answers
+        migrations with a tolerant error the source treats as "resume the
+        sequence locally" — again exactly the legacy answer."""
         self.config = config
         self.handler = handler
         self.tracer = tracer
         self.push_handler = push_handler
+        self.migrate_handler = migrate_handler
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: observability, read by /stats
@@ -79,6 +91,8 @@ class KVTransferService:
         self.blocks_served = 0
         self.pushes_served = 0
         self.blocks_pushed = 0
+        self.migrations_served = 0
+        self.migration_blocks_accepted = 0
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -141,6 +155,9 @@ class KVTransferService:
             push = decode_push(payload)
             if push is not None:
                 return self._handle_push(*push)
+            migrate = decode_migrate(payload)
+            if migrate is not None:
+                return self._handle_migrate(*migrate)
             return encode_error("malformed request")
         model, hashes, max_blocks, traceparent = req
         span = None
@@ -203,6 +220,29 @@ class KVTransferService:
         self.pushes_served += 1
         self.blocks_pushed += accepted
         return encode_push_ack(accepted, headroom)
+
+    def _handle_migrate(
+        self, model: str, source_pod: str, migration: MigrationPayload
+    ) -> bytes:
+        """Live sequence migration: install the chain and admit the
+        continuation via the pod's ``migrate_handler``, ack ``(accepted,
+        resumed)``. Refusals are plain protocol errors — the source's
+        fallback is resuming the sequence locally (cold recompute), so
+        nothing here may raise."""
+        if self.migrate_handler is None:
+            return encode_error("migrate unsupported (FLEET_CONTROLLER off)")
+        if model != self.config.model_name:
+            return encode_error(
+                f"model mismatch: serving {self.config.model_name!r}"
+            )
+        try:
+            accepted, resumed = self.migrate_handler(source_pod, migration)
+        except Exception as e:
+            log.exception("migrate handler failed")
+            return encode_error(f"migrate failed: {type(e).__name__}")
+        self.migrations_served += 1
+        self.migration_blocks_accepted += accepted
+        return encode_migrate_ack(accepted, resumed)
 
     def _cap_bytes(
         self, blocks: list[BlockPayload], n_requested: int

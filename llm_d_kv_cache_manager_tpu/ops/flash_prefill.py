@@ -44,6 +44,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# JAX renamed pltpu.TPUMemorySpace -> pltpu.MemorySpace (~0.5); resolve
+# whichever spelling this install has so the kernel runs on both.
+_MemorySpace = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
 # Finite: a fully-masked score row must yield exp(-1e30 - -1e30) = 1,
 # zeroed by the mask multiply — float('-inf') would produce inf-inf = NaN.
 _NEG_INF = -1e30
@@ -598,8 +602,8 @@ def _flash_prefill_dma(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, group, d), q_index),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_MemorySpace.ANY),
             pl.BlockSpec((1, 1, bk_chunk, d), chunk_index),
             pl.BlockSpec((1, 1, bk_chunk, d), chunk_index),
         ],

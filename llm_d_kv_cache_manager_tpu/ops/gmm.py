@@ -41,6 +41,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..models.quant import QuantizedTensor
 
+# JAX renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams (~0.5);
+# resolve whichever spelling this install has so the kernel runs on both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 #: (tm, tk, tn) tile-size ceilings, from the on-chip sweep at Qwen3-30B
 #: geometry (128 experts, d=2048, f=768, 16k rows): 256-row tiles balance
 #: boundary-visit waste (visits ≈ max(m_tiles, nonempty groups) whatever
@@ -237,7 +241,7 @@ def _gmm_int8(lhs, q, group_sizes, *, interpret: bool):
             grid=(tiles_n, num_active_tiles, tiles_k),
             scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")
         ),
         cost_estimate=pl.CostEstimate(

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -324,6 +325,14 @@ class ScoringService:
             self.fleet_health.on_pod_swept = (
                 lambda pod: self.lifecycle.observe_pod_gone(pod, "ttl_swept")
             )
+        #: fleet miss-ratio-curve registry: per-pod ``/debug/mrc``
+        #: payloads, pushed by pods (POST /debug/mrc) or an in-process
+        #: fleet harness, aggregated on read into the ONE fleet curve the
+        #: fleet controller scales on. Plain dict + lock, no knob: an
+        #: empty registry answers disabled-shaped, same as a pod with
+        #: OBS_LIFECYCLE off — nothing changes until somebody reports.
+        self._pod_mrc: dict[str, dict] = {}  # guarded_by: _pod_mrc_mu
+        self._pod_mrc_mu = threading.Lock()
         #: predicted-TTFT routing (ROUTE_PREDICT): the latency model +
         #: per-pod corrector. None (default) = no predictor, no new body
         #: fields read, bit-identical responses and /stats.
@@ -905,6 +914,50 @@ class ScoringService:
         status, payload = debug_lifecycle_payload(self.lifecycle, request.query)
         return web.json_response(payload, status=status)
 
+    # -- fleet miss-ratio curve (the autoscaler's capacity signal) ----------
+    def report_mrc(self, pod: str, payload: Optional[dict]) -> None:
+        """Register one pod's ``/debug/mrc`` payload (None drops the pod
+        from the aggregate — a retired pod's stale curve must not keep
+        voting). Called by the POST handler and by in-process fleet
+        harnesses directly."""
+        with self._pod_mrc_mu:
+            if payload is None:
+                self._pod_mrc.pop(pod, None)
+            else:
+                self._pod_mrc[pod] = payload
+
+    def fleet_mrc(self) -> dict:
+        """The fleet-aggregated miss-ratio curve: per-pod sampled curves
+        merged sampled-weighted (aggregate == per-pod sum of sampled hits
+        over summed samples — pinned by test)."""
+        from ..kvcache.controller.mrc import aggregate_mrc
+
+        with self._pod_mrc_mu:
+            per_pod = dict(self._pod_mrc)
+        return aggregate_mrc(per_pod)
+
+    async def handle_debug_mrc(self, request: web.Request) -> web.Response:
+        """GET: the fleet curve (disabled-shaped until any pod reports).
+        POST: ``{"pod": ..., "mrc": {...}}`` registers a pod's curve
+        (``"mrc": null`` withdraws it)."""
+        if request.method == "POST":
+            try:
+                body = await request.json()
+                pod = body["pod"]
+                mrc = body.get("mrc")
+                if not isinstance(pod, str) or not (
+                    mrc is None or isinstance(mrc, dict)
+                ):
+                    raise TypeError
+            except Exception:
+                return web.json_response(
+                    {"error": "want {'pod': str, 'mrc': dict|null}"},
+                    status=400,
+                )
+            self.report_mrc(pod, mrc)
+            return web.json_response({"ok": True})
+        return web.json_response(self.fleet_mrc())
+
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/score_completions", self.handle_score_completions)
@@ -916,6 +969,8 @@ class ScoringService:
         app.router.add_get("/debug/staleness", self.handle_debug_staleness)
         app.router.add_get("/debug/audit", self.handle_debug_audit)
         app.router.add_get("/debug/lifecycle", self.handle_debug_lifecycle)
+        app.router.add_get("/debug/mrc", self.handle_debug_mrc)
+        app.router.add_post("/debug/mrc", self.handle_debug_mrc)
         return app
 
 

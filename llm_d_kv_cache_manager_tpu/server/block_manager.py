@@ -392,6 +392,37 @@ class BlockManager:
             "host_dram": list(self._host_cached.keys()),
         }
 
+    def hot_chains(self, limit: int) -> list[list[int]]:
+        """The longest HBM-resident prefix chains, in chain (root→leaf)
+        order — the donor-side warm sets fleet scale-up revival pulls onto
+        a new pod. A chain is read leaf-back via ``parent_hash`` links and
+        truncated at the first non-resident ancestor (the export path's
+        consecutive-run rule would stop there anyway). Caller must be the
+        engine loop (page-pool ownership rule)."""
+        if limit <= 0:
+            return []
+        parents = {
+            self._pages[p].parent_hash
+            for p in self._cached.values()
+            if self._pages[p].parent_hash is not None
+        }
+        chains: list[list[int]] = []
+        for h, page in self._cached.items():
+            if h in parents:
+                continue  # interior block; its leaf's walk covers it
+            chain: list[int] = []
+            cur: Optional[int] = h
+            while cur is not None:
+                p = self._cached.get(cur)
+                if p is None:
+                    break  # ancestor evicted: the resident run starts here
+                chain.append(cur)
+                cur = self._pages[p].parent_hash
+            chain.reverse()
+            chains.append(chain)
+        chains.sort(key=len, reverse=True)
+        return chains[:limit]
+
     # -- cross-pod transfer (kvcache/transfer) ------------------------------
     def is_block_resident(self, h: int) -> bool:
         """True when ``h`` lives in either tier (HBM page or host slot)."""

@@ -57,6 +57,7 @@ from ..kvcache.kvevents import (
 from ..kvcache.transfer import (
     KVTransferClient,
     KVTransferService,
+    MigrationPayload,
     TransferClientConfig,
     TransferClientPool,
     TransferError,
@@ -744,6 +745,16 @@ class PodServerConfig:
     #: the recorder; 8.0 ≈ "budget gone in 1/8 of the window" — between
     #: the classic 14.4x page and 6x ticket multiwindow alert arms)
     obs_flight_burn: float = 8.0
+    # -- fleet controller (ISSUE 17; off by default = bit-identical legacy
+    # -- behavior, /stats fields, and wire bytes) ---------------------------
+    #: master switch (``FLEET_CONTROLLER``): this pod participates in
+    #: MRC-driven autoscaling — it accepts live-migrated in-flight decode
+    #: sequences over the transfer fabric (admitted via the PR 7
+    #: ``importing`` state and resumed mid-generation with greedy parity)
+    #: and may migrate its own sequences out on a scale-down. Off
+    #: (default) answers migrations with the same tolerant refusal a
+    #: legacy service gives, and ``migrate_out`` refuses locally.
+    fleet_controller: bool = False
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -843,6 +854,8 @@ class PodServerConfig:
         cfg.obs_flight_burn = float(
             os.environ.get("OBS_FLIGHT_BURN", cfg.obs_flight_burn)
         )
+        # Fleet controller (ISSUE 17; 0/unset = off, legacy behavior).
+        cfg.fleet_controller = _env_bool("FLEET_CONTROLLER", "0")
 
         eng = cfg.engine
         eng.block_manager = BlockManagerConfig(
@@ -1150,6 +1163,27 @@ class PodServer:
         ]
         if self.config.remote_tier and self._remote_peers:
             self.engine.on_demotion = self._stage_demotions
+        # -- fleet controller / live migration (FLEET_CONTROLLER; off = ----
+        # -- none of this runs) ---------------------------------------------
+        #: sequence freeze+export requests staged for the engine loop:
+        #: (request_id, future -> (seq, MigrationPayload) | None)
+        self._migrate_freezes: deque[tuple[str, Future]] = deque()  # guarded_by: _mu|_work
+        #: migration verdicts staged for the engine loop:
+        #: (seq, migrated: bool, future)
+        self._migrate_settles: deque[tuple] = deque()  # guarded_by: _mu|_work
+        #: inbound migrations staged for the engine loop:
+        #: (source_pod, MigrationPayload, future -> (accepted, resumed))
+        self._migrations_in: deque[tuple] = deque()  # guarded_by: _mu|_work
+        #: continuation futures for migrated-in sequences, request_id ->
+        #: Future (resolves with the resumed sequence — the controller's
+        #: handle on the moved request)
+        self._migrated_in_futures: dict[str, Future] = {}  # guarded_by: _mu|_work
+        #: controller read hop: zero-arg callables run on the engine loop
+        #: (warm-chain walks, live-request snapshots — engine-owned state)
+        self._controller_reads: deque[tuple] = deque()  # guarded_by: _mu|_work
+        self.migrations_out = 0  # sequences resumed on a peer  # guarded_by: _mu|_work
+        self.migrations_in = 0  # sequences resumed here  # guarded_by: _mu|_work
+        self.migration_fallbacks = 0  # -> local cold recompute  # guarded_by: _mu|_work
         if self.config.transfer_endpoint:
             self._transfer_service = KVTransferService(
                 TransferServiceConfig(
@@ -1166,6 +1200,14 @@ class PodServer:
                     self._serve_push
                     if self.config.remote_tier
                     and self.config.remote_store_pages > 0
+                    else None
+                ),
+                # Live-migration acceptance rides the FLEET_CONTROLLER
+                # knob the same way: off answers with the tolerant
+                # refusal the source treats as "resume locally".
+                migrate_handler=(
+                    self._serve_migrate
+                    if self.config.fleet_controller
                     else None
                 ),
             )
@@ -1358,13 +1400,23 @@ class PodServer:
                 list(self._transfer_exports)
                 + list(self._transfer_imports)
                 + list(self._remote_pushes)
+                + list(self._migrate_freezes)
+                + list(self._migrate_settles)
+                + list(self._migrations_in)
+                + list(self._controller_reads)
                 + [(fut,) for fut in self._digest_requests]
             )
             self._transfer_exports.clear()
             self._transfer_imports.clear()
             self._remote_pushes.clear()
+            self._migrate_freezes.clear()
+            self._migrate_settles.clear()
+            self._migrations_in.clear()
+            self._controller_reads.clear()
             self._demote_queue.clear()
             self._digest_requests.clear()
+            migrated_futs = list(self._migrated_in_futures.values())
+            self._migrated_in_futures.clear()
             self._import_dones.clear()
             jobs = list(self._pull_jobs.values())
             self._pull_jobs.clear()
@@ -1384,7 +1436,7 @@ class PodServer:
             fut = item[-1]
             if not fut.done():
                 fut.set_exception(exc)
-        for fut in list(self._futures.values()):
+        for fut in list(self._futures.values()) + migrated_futs:
             if not fut.done():
                 fut.set_exception(exc)
         self._futures.clear()
@@ -1562,6 +1614,10 @@ class PodServer:
                         or self._remote_pushes
                         or self._digest_requests
                         or self._import_dones
+                        or self._migrate_freezes
+                        or self._migrate_settles
+                        or self._migrations_in
+                        or self._controller_reads
                         or self.engine.has_ready_work
                     ):
                         self._work.wait(timeout=0.1)
@@ -1581,6 +1637,14 @@ class PodServer:
                     self._digest_requests.clear()
                     import_dones = list(self._import_dones)
                     self._import_dones.clear()
+                    freezes = list(self._migrate_freezes)
+                    self._migrate_freezes.clear()
+                    settles = list(self._migrate_settles)
+                    self._migrate_settles.clear()
+                    migrations_in = list(self._migrations_in)
+                    self._migrations_in.clear()
+                    controller_reads = list(self._controller_reads)
+                    self._controller_reads.clear()
                 # Engine state is owned by this thread — no lock held while
                 # admitting or stepping (device compute can take a while).
                 # Imports land before admissions so a request staged with
@@ -1618,6 +1682,31 @@ class PodServer:
                 # committed.
                 for seq in import_dones:
                     seq.importing = False
+                # Migration ops in causal order: freezes (park + export)
+                # before settles (commit/rollback a previous freeze) before
+                # inbound admissions — all engine-loop-owned state.
+                for rid, fut in freezes:
+                    try:
+                        fut.set_result(self._freeze_for_migration(rid))
+                    except Exception as e:
+                        fut.set_exception(e)
+                for seq, migrated, fut in settles:
+                    try:
+                        fut.set_result(self._settle_migration(seq, migrated))
+                    except Exception as e:
+                        fut.set_exception(e)
+                for source_pod, migration, fut in migrations_in:
+                    try:
+                        fut.set_result(
+                            self._admit_migration(source_pod, migration)
+                        )
+                    except Exception as e:
+                        fut.set_exception(e)
+                for call, fut in controller_reads:
+                    try:
+                        fut.set_result(call())
+                    except Exception as e:
+                        fut.set_exception(e)
                 for tokens, sampling, deadline, rid, fut, span, action, pull in staged:
                     try:
                         seq = self.engine.add_request(
@@ -1938,6 +2027,303 @@ class PodServer:
             self._remote_pushes.append((source_pod, blocks, fut))
             self._work.notify()
         return fut.result(timeout=max(self.config.transfer_timeout_s * 3, 30.0))
+
+    # -- live sequence migration (FLEET_CONTROLLER) --------------------------
+    def migrate_out(
+        self,
+        request_id: str,
+        target_endpoint: str,
+        timeout_s: Optional[float] = None,
+    ) -> bool:
+        """Live-migrate one in-flight request to the pod serving
+        ``target_endpoint`` (its transfer endpoint). The engine loop
+        freezes the sequence preemption-style (generated tokens fold into
+        the prompt; registered pages survive in the prefix cache) and
+        exports its KV chain; this thread ships decode state + chain over
+        the transfer fabric; on the target's ``resumed`` ack the local
+        half finishes with ``finish_reason="migrated"`` (its submit
+        future resolves with the partial sequence — the target's
+        continuation carries the rest). ANY failure — dead target,
+        refusal, timeout, undecodable ack — rolls back to local
+        recompute: the sequence re-enters scheduling exactly as a
+        preemption would, pages back to baseline. Returns True only when
+        the target resumed the sequence. ``FLEET_CONTROLLER`` off =
+        False without touching the engine (bit-identical legacy)."""
+        if not self.config.fleet_controller:
+            return False
+        wait = max(self.config.transfer_timeout_s * 3, 30.0)
+        fut: Future = Future()
+        with self._work:
+            if not self._running or self._failed is not None:
+                return False
+            self._migrate_freezes.append((request_id, fut))
+            self._work.notify()
+        try:
+            frozen = fut.result(timeout=wait)
+        except Exception:
+            log.exception("migration freeze failed", request=request_id)
+            return False
+        if frozen is None:
+            return False  # not live here (finished, unknown, or importing)
+        seq, payload = frozen
+        resumed = False
+        client = self._get_client(target_endpoint)
+        if client is not None:
+            try:
+                _accepted, resumed = client.migrate(
+                    self.config.model_name,
+                    self.config.pod_identifier,
+                    payload,
+                    timeout_s=timeout_s,
+                )
+            except TransferError as e:
+                log.warning(
+                    "migration transfer failed; resuming locally",
+                    request=request_id,
+                    target=target_endpoint,
+                    error=str(e),
+                )
+            except Exception:
+                log.exception("migration transfer failed; resuming locally")
+        sfut: Future = Future()
+        with self._work:
+            if not self._running or self._failed is not None:
+                return False
+            self._migrate_settles.append((seq, resumed, sfut))
+            self._work.notify()
+        try:
+            ok = bool(sfut.result(timeout=wait))
+        except Exception:
+            log.exception("migration settle failed", request=request_id)
+            return False
+        with self._mu:
+            if ok:
+                self.migrations_out += 1
+            else:
+                self.migration_fallbacks += 1
+        self._flight_event(
+            "migration",
+            direction="out",
+            request=request_id,
+            target=target_endpoint,
+            resumed=ok,
+            blocks=len(payload.blocks),
+            tokens=len(payload.token_ids),
+        )
+        return ok
+
+    def migrated_future(self, request_id: str) -> Optional[Future]:
+        """The continuation future of a request migrated INTO this pod
+        (resolves with the resumed sequence, whose ``generated_tokens``
+        is the request's full user-visible output). None when no such
+        migration was admitted. Entries are retained for the pod's
+        lifetime — a migration is a rare, operator-scale event."""
+        with self._mu:
+            return self._migrated_in_futures.get(request_id)
+
+    def _controller_read(self, call):
+        """Run a zero-arg callable on the engine loop and wait — the fleet
+        controller's read hop into engine-owned state (scheduler deques,
+        the prefix cache). Returns None when the pod is down."""
+        fut: Future = Future()
+        with self._work:
+            if not self._running or self._failed is not None:
+                return None
+            self._controller_reads.append((call, fut))
+            self._work.notify()
+        return fut.result(timeout=max(self.config.transfer_timeout_s * 3, 30.0))
+
+    def live_requests(self) -> list[str]:
+        """Request ids of every live (admitted, unfinished) sequence — the
+        fleet controller's scale-down migration plan, snapshotted on the
+        engine loop so it can never tear against a step."""
+
+        def read() -> list[str]:
+            sch = self.engine.scheduler
+            return [
+                seq.request_id
+                for bucket in (sch.waiting, sch.prefilling, sch.running)
+                for seq in bucket
+                if not seq.is_finished()
+            ]
+
+        return self._controller_read(read) or []
+
+    def warm_chains(self, limit: int) -> list[list[int]]:
+        """Chain-ordered block-hash lists of this pod's hottest resident
+        prefix chains (longest first) — the donor side of fleet scale-up
+        warm revival. Empty with ``FLEET_CONTROLLER`` off."""
+        if not self.config.fleet_controller or limit <= 0:
+            return []
+        return (
+            self._controller_read(
+                lambda: self.engine.block_manager.hot_chains(limit)
+            )
+            or []
+        )
+
+    def revive_chain(
+        self,
+        chain_hashes: list[int],
+        source_endpoint: str,
+        timeout_s: Optional[float] = None,
+    ) -> int:
+        """Warm-set revival on fleet scale-up: pull one chain (hashes in
+        chain order, from a donor's ``warm_chains``) over the transfer
+        fabric and commit it locally. Returns blocks imported; 0 on ANY
+        failure — revival is an optimization, the new pod just starts
+        colder. 0 with ``FLEET_CONTROLLER`` off."""
+        if not self.config.fleet_controller or not chain_hashes:
+            return 0
+        client = self._get_client(source_endpoint)
+        if client is None:
+            return 0
+        try:
+            blocks, _complete = client.fetch(
+                self.config.model_name,
+                list(chain_hashes),
+                self.config.transfer_max_blocks,
+                timeout_s=timeout_s,
+            )
+            if not blocks:
+                return 0
+            return self.submit_import(blocks).result(
+                timeout=timeout_s or max(self.config.transfer_timeout_s * 3, 30.0)
+            )
+        except (TransferError, RuntimeError, FuturesTimeout) as e:
+            log.warning(
+                "warm revival pull failed; starting cold",
+                source=source_endpoint,
+                error=repr(e),
+            )
+            return 0
+
+    def _freeze_for_migration(self, request_id: str):
+        """Engine-loop half of ``migrate_out``: freeze the sequence and
+        build the wire payload (decode state + exported KV chain) in ONE
+        loop cycle, so no eviction can interleave between the freeze
+        releasing the pages and the export reading them."""
+        frozen = self.engine.freeze_for_migration(request_id)
+        if frozen is None:
+            return None
+        seq, hashes = frozen
+        blocks = self.engine.export_kv_blocks(hashes) if hashes else []
+        payload = MigrationPayload(
+            request_id=request_id,
+            token_ids=list(seq.prompt_tokens),  # post-fold: full history
+            user_prompt_len=seq.user_prompt_len,
+            num_generated=seq.num_generated,
+            max_new_tokens=seq.sampling.max_new_tokens,
+            temperature=seq.sampling.temperature,
+            top_k=seq.sampling.top_k,
+            top_p=seq.sampling.top_p,
+            stop_token_ids=tuple(seq.sampling.stop_token_ids),
+            deadline_remaining_s=(
+                max(seq.deadline - time.monotonic(), 0.0)
+                if seq.deadline is not None
+                else None
+            ),
+            blocks=blocks,
+        )
+        return seq, payload
+
+    def _settle_migration(self, seq: Sequence, migrated: bool) -> bool:
+        """Engine-loop half of ``migrate_out``'s verdict: commit (finish
+        the local half; its future resolves) or roll back (clear
+        ``importing`` so the scheduler re-admits the folded sequence —
+        cold recompute at worst)."""
+        if seq.is_finished():
+            # Aborted/shed while the wire transfer ran (e.g. the drain
+            # hammer): its future already resolved; nothing to settle.
+            return False
+        if not migrated:
+            self.engine.cancel_migration(seq)
+            return False
+        self.engine.finish_migrated(seq)
+        self._resolve(seq)
+        return True
+
+    def _serve_migrate(self, source_pod: str, migration) -> tuple[int, bool]:
+        """KVTransferService migrate handler (service thread): hop onto
+        the engine loop — install the chain, admit the continuation
+        through the ``importing`` state — and wait for the verdict. A
+        draining pod refuses (``resumed=False``): the source resumes
+        locally rather than migrating onto a pod about to disappear."""
+        fut: Future = Future()
+        with self._work:
+            if not self._running or self._failed is not None or self._draining:
+                return 0, False
+            self._migrations_in.append((source_pod, migration, fut))
+            self._work.notify()
+        return fut.result(timeout=max(self.config.transfer_timeout_s * 3, 30.0))
+
+    def _admit_migration(self, source_pod: str, migration) -> tuple[int, bool]:
+        """Engine-loop half of an inbound migration: install the shipped
+        chain, then admit the continuation — the full token history as
+        the prompt (exactly the ``fold_for_preemption`` representation,
+        so the warm prefill cache-hits the imported pages and greedy
+        decode resumes token-identically) — entering through the PR 7
+        ``importing`` state, cleared next cycle."""
+        installed = 0
+        if migration.blocks:
+            try:
+                installed = self.engine.import_kv_blocks(migration.blocks)
+            except Exception:
+                # Geometry/chain verification failures already degrade
+                # inside import_kv_blocks; anything past that just means
+                # the continuation prefills colder.
+                log.exception("migration import failed; continuation recomputes")
+        sampling = SamplingParams(
+            max_new_tokens=migration.max_new_tokens,
+            temperature=migration.temperature,
+            top_k=migration.top_k,
+            top_p=migration.top_p,
+            stop_token_ids=tuple(migration.stop_token_ids),
+        )
+        try:
+            seq = self.engine.add_request(
+                list(migration.token_ids),
+                sampling,
+                request_id=migration.request_id,
+                deadline=(
+                    time.monotonic() + migration.deadline_remaining_s
+                    if migration.deadline_remaining_s is not None
+                    else None
+                ),
+            )
+        except ValueError as e:
+            log.warning(
+                "refusing migration; source resumes locally",
+                request=migration.request_id,
+                error=str(e),
+            )
+            return installed, False
+        # Continue the source's bookkeeping: with generated tokens folded
+        # into the prompt, ``generated_tokens`` and the max_new_tokens /
+        # stop-token conditions line up exactly with an unmigrated run.
+        seq.user_prompt_len = migration.user_prompt_len
+        seq.num_generated = migration.num_generated
+        seq.importing = True
+        fut: Future = Future()
+        fut.request_id = migration.request_id
+        self._futures[seq.seq_id] = fut
+        with self._work:
+            self._pending += 1
+            # _resolve releases user_prompt_len tokens; mirror it here.
+            self._pending_tokens += seq.user_prompt_len
+            self._migrated_in_futures[migration.request_id] = fut
+            self.migrations_in += 1
+            self._import_dones.append(seq)
+            self._work.notify()
+        self._flight_event(
+            "migration",
+            direction="in",
+            source=source_pod,
+            request=migration.request_id,
+            blocks=installed,
+            tokens=len(migration.token_ids),
+        )
+        return installed, True
 
     def _stage_demotions(self, payloads: list) -> None:
         """``Engine.on_demotion`` sink (engine loop): park wire-ready
@@ -2844,6 +3230,28 @@ class PodServer:
                 # Flight block only with the knob on: the knobs-off
                 # /stats payload stays bit-identical.
                 payload["flight"] = self.flight.snapshot()
+            if self.config.fleet_controller:
+                # Fleet block only with the knob on: the knobs-off
+                # /stats payload stays bit-identical.
+                with self._mu:
+                    migrations_out = self.migrations_out
+                    migrations_in = self.migrations_in
+                    migration_fallbacks = self.migration_fallbacks
+                payload["fleet"] = {
+                    "migrations_out": migrations_out,
+                    "migrations_in": migrations_in,
+                    "migration_fallbacks": migration_fallbacks,
+                    "migrations_served": (
+                        self._transfer_service.migrations_served
+                        if self._transfer_service
+                        else 0
+                    ),
+                    "migration_blocks_accepted": (
+                        self._transfer_service.migration_blocks_accepted
+                        if self._transfer_service
+                        else 0
+                    ),
+                }
             return web.json_response(payload)
 
         async def metrics(_request: web.Request) -> web.Response:
