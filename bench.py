@@ -157,6 +157,20 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
                        corrector online). Acceptance: predicted p50/p99
                        TTFT <= both comparators on burst and ramp with
                        hit-rate parity vs precise (0 skips the pass)
+  BENCH_TENANT_QOS=1   two-class tenant-QoS arm (ISSUE 18): a steady
+                       premium trickle over a small hot-prefix set plus
+                       a background tenant running the burst shape over
+                       a wide churny set, on ONE capacity-constrained
+                       pod. Three runs (premium alone / knob off / knob
+                       on under BENCH_TENANT_QOS_SPEC) report per-tenant
+                       TTFT tails, hit rates, 429s-at-the-door, priority
+                       preemptions, and per-tenant MRC slices — the
+                       isolation evidence for TENANT_QOS
+  BENCH_TENANT_PAGES=N pool size for the tenant-QoS arm (default: the
+                       premium warm set + ~6 active sequences)
+  BENCH_TENANT_QOS_SPEC=...  policy for the knob-on run (default:
+                       premium prio 0 weight 4; batch prio 1 with
+                       max_waiting=6 and cache_share=0.3)
 """
 
 from __future__ import annotations
@@ -1668,6 +1682,171 @@ def run_fleet_arm(
     return out
 
 
+def run_tenant_qos_arm(
+    workload, tenant_of, params, engine_cfg, max_new_tokens, qos_spec=None,
+):
+    """ISSUE 18 two-class arm: ONE capacity-constrained pod (tenant QoS
+    is a per-pod mechanism) on the virtual clock, serving an interleaved
+    premium + background schedule. ``tenant_of(i)`` names request i's
+    tenant; ``qos_spec=None`` is the knob-off comparator — the identical
+    engine and schedule with no tenant dimension anywhere (requests are
+    still sliced by tenant for reporting, the engine never sees it).
+
+    With a spec, the arm drives the PRODUCT machinery end to end: the
+    parsed ``TenantQoS`` budget table gates admission on the virtual
+    clock (a budget rejection is the 429 arm — the request is shed at
+    the door, exactly what the serving layer does), the scheduler runs
+    priority ordering + preemption, and the block manager runs
+    cache_share accounting with per-tenant MRC slices. Budgets release
+    on finish, mirroring ``_forget_pending``; first-prefill hit
+    accounting and first-token TTFT stay with the request across
+    preemption (same rationale as ``Pod.step_timed``)."""
+    from collections import deque as _deque
+
+    from llm_d_kv_cache_manager_tpu.obs.lifecycle import ReuseDistanceEstimator
+    from llm_d_kv_cache_manager_tpu.server.engine import Engine
+    from llm_d_kv_cache_manager_tpu.server.qos import TenantQoS, parse_tenant_qos
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    engine = Engine(engine_cfg, params=params, on_events=lambda _ev: None)
+    qos = None
+    if qos_spec:
+        qos = TenantQoS(parse_tenant_qos(qos_spec))
+        engine.scheduler.attach_qos()
+        engine.block_manager.attach_qos(qos, mrc_factory=ReuseDistanceEstimator)
+
+    # A throwaway warm-up burst before the timed loop: the in-process
+    # trace/dispatch cost of this run's shapes (batched prefill widths,
+    # decode widths) is paid once per PROCESS, so without it the cost
+    # lands entirely in the FIRST arm's TTFTs and poisons the
+    # unloaded/off/on three-way comparison. Concurrent requests exercise
+    # the same batch widths the arms hit; the warm chains' pages are
+    # untenanted LRU fodder, identical across arms.
+    warm_len = len(workload[0][2]) if workload else 8
+    wrng = np.random.default_rng(97)
+    warm_prompts = [
+        wrng.integers(0, engine_cfg.model.vocab_size, warm_len).tolist()
+        for _ in range(8)
+    ]
+    for p in warm_prompts:
+        engine.add_request(
+            p, SamplingParams(max_new_tokens=max_new_tokens)
+        )
+    while engine.has_work:
+        engine.step()
+    # ...and one repeated prompt: the warm-prefill (paged prefix-cache
+    # context) dispatch is a DIFFERENT shape than the cold prefills
+    # above, and the workloads are built around prefix reuse.
+    engine.add_request(
+        warm_prompts[0], SamplingParams(max_new_tokens=max_new_tokens)
+    )
+    while engine.has_work:
+        engine.step()
+
+    clock = 0.0
+    samples = _deque(maxlen=64)
+    seq_tenant = {}  # seq_id -> tenant slice key (arm-side bookkeeping)
+    arrivals = {}
+    ttfts = {}
+    hits = {}  # seq_id -> (cached, prompt) at FIRST prefill
+    first_seen = set()
+    rejected = {}
+
+    def step():
+        nonlocal clock
+        t0 = time.perf_counter()
+        done = engine.step()
+        dt = time.perf_counter() - t0
+        if STALL_CAP_X and len(samples) >= 20:
+            med = sorted(samples)[len(samples) // 2]
+            dt = min(dt, max(med * STALL_CAP_X, 1.0))
+        samples.append(dt)
+        clock += dt
+        for seq in list(engine.scheduler.running) + done:
+            if seq.num_generated >= 1 and seq.seq_id not in first_seen:
+                first_seen.add(seq.seq_id)
+                ttfts[seq.seq_id] = clock - arrivals[seq.seq_id]
+                hits[seq.seq_id] = (
+                    seq.num_cached_prompt, len(seq.prompt_tokens)
+                )
+        if qos is not None:
+            for seq in done:
+                qos.on_resolved(seq.tenant, seq.user_prompt_len)
+
+    for i, (t_arr, _seg, tokens) in enumerate(workload):
+        while engine.has_work and clock < t_arr:
+            step()
+        clock = max(clock, t_arr)
+        tenant = tenant_of(i)
+        sampling = SamplingParams(max_new_tokens=max_new_tokens)
+        if qos is None:
+            seq = engine.add_request(tokens, sampling)
+        else:
+            if qos.admit(tenant, len(tokens), now=clock) is not None:
+                rejected[tenant] = rejected.get(tenant, 0) + 1
+                continue
+            pol = qos.policy(tenant)
+            seq = engine.add_request(
+                tokens, sampling,
+                tenant=tenant, priority=pol.priority, qos_weight=pol.weight,
+            )
+            qos.on_admitted(tenant, len(tokens), now=clock)
+        seq_tenant[seq.seq_id] = tenant
+        arrivals[seq.seq_id] = t_arr
+    while engine.has_work:
+        step()
+
+    def _slice(tenant):
+        ids = [s for s, t in seq_tenant.items() if t == tenant]
+        lat = [ttfts[s] for s in ids if s in ttfts]
+        cached = sum(hits[s][0] for s in ids if s in hits)
+        total = sum(hits[s][1] for s in ids if s in hits)
+        return {
+            "served": len(lat),
+            "rejected": rejected.get(tenant, 0),
+            "p50_ttft_s": round(float(np.percentile(lat, 50)), 4) if lat else None,
+            "p90_ttft_s": round(float(np.percentile(lat, 90)), 4) if lat else None,
+            "p99_ttft_s": round(float(np.percentile(lat, 99)), 4) if lat else None,
+            "prefix_cache_hit_rate": (
+                round(cached / total, 4) if total else None
+            ),
+        }
+
+    out = {
+        "tenants": {
+            t: _slice(t)
+            for t in sorted(set(seq_tenant.values()) | set(rejected))
+        },
+        "priority_preempted": engine.lifecycle_stats.get(
+            "priority_preempted", 0
+        ),
+        "makespan_s": round(clock, 4),
+    }
+    if qos is not None:
+        pool = engine_cfg.block_manager.total_pages
+        out["cache"] = {
+            t: dict(s) for t, s in engine.block_manager.tenant_stats.items()
+        }
+        # Per-tenant MRC slices: the /debug/mrc sizing evidence — what
+        # each tenant's hit rate would be at the pool / half the pool,
+        # i.e. the curve an operator reads to size cache_share.
+        out["mrc"] = {}
+        for t, est in sorted(engine.block_manager._tenant_mrc.items()):
+            hit_pool = est.predicted_hit_rate(pool)
+            hit_half = est.predicted_hit_rate(max(pool // 2, 1))
+            out["mrc"][t] = {
+                "predicted_hit_at_pool": (
+                    round(hit_pool, 4) if hit_pool is not None else None
+                ),
+                "predicted_hit_at_half_pool": (
+                    round(hit_half, 4) if hit_half is not None else None
+                ),
+            }
+    del engine
+    gc.collect()
+    return out
+
+
 def run_disagg(
     workload, params, engine_cfg, n_prefill, n_decode, max_new_tokens,
     link_gbps,
@@ -2602,6 +2781,81 @@ def main() -> int:
             ]
         )
 
+    # -- Tenant QoS arm (ISSUE 18): two classes on one pod ---------------
+    # The noisy-neighbor regime the feature exists for: a steady premium
+    # trickle over a SMALL hot-prefix set, plus a background tenant
+    # running the PR 13 square-wave burst shape over a wide churny
+    # prefix set, both against ONE capacity-constrained pod. Three runs:
+    # premium alone (the unloaded reference), both classes with the knob
+    # off (the background burst wrecks premium's tail and evicts its
+    # warm set), and both classes under TENANT_QOS (admission budgets
+    # shed background at the door, priority preemption keeps premium's
+    # prefill first in line, cache_share keeps its warm set resident).
+    tenant_qos_detail = None
+    if os.environ.get("BENCH_TENANT_QOS", "0") == "1":
+        import dataclasses as _dc
+
+        tq_rng = np.random.default_rng(1812)
+        tq_prem_groups = max(n_groups // 4, 2)
+        tq_bg_groups = max(n_groups, 4)
+        tq_reqs = max(reqs_per_group * 2, 6)
+        prem_wl = build_workload(
+            tq_rng, tq_prem_groups, tq_reqs, prefix_len, suffix_len,
+            model_cfg.vocab_size, [qps_mid * 0.5] * 5,
+        )
+        bg_wl = build_workload(
+            tq_rng, tq_bg_groups, tq_reqs, prefix_len, suffix_len,
+            model_cfg.vocab_size,
+            [qps_mid * s for s in (0.7, 5.0, 0.7, 5.0, 0.7)],
+        )
+        merged = sorted(
+            [(t, seg, toks, "premium") for t, seg, toks in prem_wl]
+            + [(t, seg, toks, "batch") for t, seg, toks in bg_wl],
+            key=lambda r: r[0],
+        )
+        tq_wl = [(t, seg, toks) for t, seg, toks, _name in merged]
+        tq_tenants = [name for _t, _seg, _toks, name in merged]
+        # Pool sized to hold premium's warm prefix set plus a few active
+        # sequences but NOT the background churn — the regime where
+        # cache_share has something to protect. (A pool that fits both
+        # working sets shows nothing; the main pass already covers it.)
+        prefix_pages = -(-prefix_len // page)
+        seq_pages = -(-(prefix_len + suffix_len + max_new + 1) // page)
+        tq_pages = int(
+            os.environ.get(
+                "BENCH_TENANT_PAGES",
+                str(tq_prem_groups * prefix_pages + 6 * seq_pages),
+            )
+        )
+        tq_cfg = _dc.replace(
+            engine_cfg,
+            block_manager=_dc.replace(
+                engine_cfg.block_manager, total_pages=tq_pages
+            ),
+        )
+        tq_spec = os.environ.get(
+            "BENCH_TENANT_QOS_SPEC",
+            "premium:prio=0,weight=4;"
+            "batch:prio=1,max_waiting=6,cache_share=0.3",
+        )
+        prem_only = [r for r, t in zip(tq_wl, tq_tenants) if t == "premium"]
+        tenant_qos_detail = {
+            "total_pages": tq_pages,
+            "qos_spec": tq_spec,
+            "n_premium": len(prem_only),
+            "n_background": len(tq_wl) - len(prem_only),
+            "unloaded_premium": run_tenant_qos_arm(
+                prem_only, lambda _i: "premium", params, tq_cfg, max_new
+            ),
+            "knob_off": run_tenant_qos_arm(
+                tq_wl, lambda i: tq_tenants[i], params, tq_cfg, max_new
+            ),
+            "knob_on": run_tenant_qos_arm(
+                tq_wl, lambda i: tq_tenants[i], params, tq_cfg, max_new,
+                qos_spec=tq_spec,
+            ),
+        }
+
     # Headline metrics are precise-vs-round_robin by definition: when a
     # BENCH_POLICIES subset omits either, the corresponding fields are
     # null rather than silently reporting another policy's numbers.
@@ -2653,6 +2907,7 @@ def main() -> int:
         "workload_family": family_results,
         "workload_family_spread": family_spreads,
         "fleet_controller": fleet_detail,
+        "tenant_qos": tenant_qos_detail,
     }
     print(json.dumps(detail), file=sys.stderr)
 
@@ -3071,6 +3326,41 @@ def main() -> int:
                         for wname, row in fleet_detail.items()
                     }
                     if fleet_detail
+                    else None
+                ),
+                # Tenant-QoS headline (ISSUE 18; null unless the
+                # BENCH_TENANT_QOS pass ran): premium's tail with the
+                # knob off vs on vs unloaded, its hit-rate protection,
+                # and the background degradation mix (429s at the door +
+                # priority preemptions — never errors).
+                "tenant_qos": (
+                    {
+                        "premium_p99_unloaded_s": tenant_qos_detail[
+                            "unloaded_premium"
+                        ]["tenants"]["premium"]["p99_ttft_s"],
+                        "premium_p99_off_s": tenant_qos_detail["knob_off"][
+                            "tenants"
+                        ]["premium"]["p99_ttft_s"],
+                        "premium_p99_on_s": tenant_qos_detail["knob_on"][
+                            "tenants"
+                        ]["premium"]["p99_ttft_s"],
+                        "premium_hit_unloaded": tenant_qos_detail[
+                            "unloaded_premium"
+                        ]["tenants"]["premium"]["prefix_cache_hit_rate"],
+                        "premium_hit_off": tenant_qos_detail["knob_off"][
+                            "tenants"
+                        ]["premium"]["prefix_cache_hit_rate"],
+                        "premium_hit_on": tenant_qos_detail["knob_on"][
+                            "tenants"
+                        ]["premium"]["prefix_cache_hit_rate"],
+                        "background_rejected": tenant_qos_detail["knob_on"][
+                            "tenants"
+                        ]["batch"]["rejected"],
+                        "priority_preempted": tenant_qos_detail["knob_on"][
+                            "priority_preempted"
+                        ],
+                    }
+                    if tenant_qos_detail
                     else None
                 ),
             }

@@ -171,7 +171,7 @@ class DisaggCoordinator:
             raise
 
     def _single_pod(
-        self, pod_name, tokens, sampling, deadline, span, replans
+        self, pod_name, tokens, sampling, deadline, span, replans, tenant=""
     ) -> DisaggResult:
         """Legacy one-pod serving (the fallback arm): exactly what the
         non-disagg fleet does today. Its failures re-plan like any hop's:
@@ -187,6 +187,7 @@ class DisaggCoordinator:
                 sampling,
                 deadline_s=self._remaining(deadline),
                 trace_ctx=span.context,
+                tenant=tenant,
             )
             seq = self._run_hop(pod, fut, deadline)
         except (DrainingError, FuturesTimeout) as e:
@@ -219,12 +220,15 @@ class DisaggCoordinator:
         sampling=None,
         *,
         deadline_s: Optional[float] = None,
+        tenant: str = "",
     ) -> DisaggResult:
         """Serve one request through the two-hop pipeline (or the
         single-pod fallback). Raises ``AdmissionError`` when the prefill
         tier sheds (carrying the Retry-After hint), ``PlanError`` when no
         healthy pod can serve at all, and whatever terminal error the
-        last re-plan attempt hit."""
+        last re-plan attempt hit. ``tenant`` (TENANT_QOS) rides every hop
+        — the prefill tier enforces the same per-tenant budgets the
+        decode tier does, so a tenant's flood sheds at ingest."""
         from ...server.sequence import SamplingParams
 
         sampling = sampling or SamplingParams()
@@ -238,7 +242,9 @@ class DisaggCoordinator:
         )
         trace_id = span.context.trace_id if span.context is not None else None
         try:
-            result = self._generate_planned(tokens, sampling, deadline, span)
+            result = self._generate_planned(
+                tokens, sampling, deadline, span, tenant
+            )
             result.trace_id = trace_id
             span.set_attr("mode", result.mode)
             span.set_attr("replans", result.replans)
@@ -250,7 +256,9 @@ class DisaggCoordinator:
         finally:
             span.end()
 
-    def _generate_planned(self, tokens, sampling, deadline, span) -> DisaggResult:
+    def _generate_planned(
+        self, tokens, sampling, deadline, span, tenant=""
+    ) -> DisaggResult:
         exclude: set = set()
         #: one re-plan budget shared by both hops (the decode hop re-plans
         #: in place to reuse the finished prefill; its attempts count here)
@@ -267,10 +275,11 @@ class DisaggCoordinator:
                 if plan.mode == "single":
                     return self._single_pod(
                         plan.decode_pod, tokens, sampling, deadline, span,
-                        state["replans"],
+                        state["replans"], tenant,
                     )
                 return self._two_hop(
-                    plan, tokens, sampling, deadline, span, state, exclude
+                    plan, tokens, sampling, deadline, span, state, exclude,
+                    tenant,
                 )
             except _HopFailed as hf:
                 # Dead/draining pod mid-flight: exclude it and re-plan.
@@ -295,7 +304,8 @@ class DisaggCoordinator:
                 )
 
     def _two_hop(
-        self, plan: DisaggPlan, tokens, sampling, deadline, span, state, exclude
+        self, plan: DisaggPlan, tokens, sampling, deadline, span, state,
+        exclude, tenant="",
     ) -> DisaggResult:
         from ...server.serve import DrainingError
 
@@ -308,6 +318,7 @@ class DisaggCoordinator:
                 replace(sampling, max_new_tokens=1),
                 deadline_s=self._remaining(deadline),
                 trace_ctx=span.context,
+                tenant=tenant,
             )
             pseq = self._run_hop(prefill_pod, pfut, deadline)
         except (DrainingError, FuturesTimeout) as e:
@@ -385,7 +396,7 @@ class DisaggCoordinator:
             try:
                 dfut = self._submit_decode_hop(
                     decode_pod, handoff_tokens, decode_sampling, deadline,
-                    span, pull_source, prompt_len=len(tokens),
+                    span, pull_source, prompt_len=len(tokens), tenant=tenant,
                 )
                 dseq = self._run_hop(decode_pod, dfut, deadline)
             except (DrainingError, RuntimeError, FuturesTimeout) as e:
@@ -457,7 +468,7 @@ class DisaggCoordinator:
 
     def _submit_decode_hop(
         self, decode_pod, handoff_tokens, sampling, deadline, span,
-        pull_source, prompt_len,
+        pull_source, prompt_len, tenant="",
     ):
         """Decode-tier admission: async-pull pods import the chain in the
         PR 7 ``importing`` state (admission never blocks on the wire);
@@ -479,6 +490,7 @@ class DisaggCoordinator:
             trace_ctx=span.context,
             route_action="pull" if pull_source is not None else None,
             pull_source=pull_source,
+            tenant=tenant,
         )
 
     def stats(self) -> dict:

@@ -102,9 +102,13 @@ class BlockLifecycleLedger:
         self.tracked_evicted = 0  # guarded_by: _mu
         #: (from, to, reason) -> count (the shadow of the labeled counter)
         self._counts: dict[tuple[str, str, str], int] = {}  # guarded_by: _mu
+        #: tenant -> transition count (TENANT_QOS; only ever populated by
+        #: tenant-tagged records, so it stays empty — and out of
+        #: snapshots — with the knob off)
+        self._tenant_counts: dict[str, int] = {}  # guarded_by: _mu
 
     # -- write side ----------------------------------------------------------
-    def _apply(self, chain_hash, tier, reason, pod, now):  # kvlint: holds=_mu
+    def _apply(self, chain_hash, tier, reason, pod, now, tenant=""):  # kvlint: holds=_mu
         """The locked half of a transition: state/ring/count mutation.
         Returns ``(frm, residency|None)`` for the caller's callbacks."""
         key = (pod, chain_hash)
@@ -116,16 +120,20 @@ class BlockLifecycleLedger:
             while len(self._state) > self._max_tracked:
                 self._state.popitem(last=False)
                 self.tracked_evicted += 1
-        self._ring.append(
-            {
-                "hash": chain_hash,
-                "pod": pod,
-                "from": frm,
-                "to": tier,
-                "reason": reason,
-                "t": round(now, 6),
-            }
-        )
+        row = {
+            "hash": chain_hash,
+            "pod": pod,
+            "from": frm,
+            "to": tier,
+            "reason": reason,
+            "t": round(now, 6),
+        }
+        if tenant:
+            # Tenant label only when tagged (TENANT_QOS on): knob-off ring
+            # rows keep their exact legacy shape.
+            row["tenant"] = tenant
+            self._tenant_counts[tenant] = self._tenant_counts.get(tenant, 0) + 1
+        self._ring.append(row)
         self.transitions += 1
         k = (frm, tier, reason)
         self._counts[k] = self._counts.get(k, 0) + 1
@@ -145,15 +153,24 @@ class BlockLifecycleLedger:
             log.exception("lifecycle observer callback failed")
 
     def record(
-        self, chain_hash: int, tier: str, reason: str, pod: str = ""
+        self,
+        chain_hash: int,
+        tier: str,
+        reason: str,
+        pod: str = "",
+        tenant: str = "",
     ) -> None:
         """One block landed in ``tier`` (``"none"`` = left the ladder) for
         ``reason``. The *from* tier and the departed tier's residency are
-        derived from tracked state. Never raises — observability must not
-        fail the transition it observes."""
+        derived from tracked state. ``tenant`` (TENANT_QOS) tags the ring
+        row and the per-tenant counts; "" (the default, and always with
+        the knob off) records the exact legacy row. Never raises —
+        observability must not fail the transition it observes."""
         now = self._clock()
         with self._mu:
-            frm, residency = self._apply(chain_hash, tier, reason, pod, now)
+            frm, residency = self._apply(
+                chain_hash, tier, reason, pod, now, tenant=tenant
+            )
         self._fire(frm, tier, reason, residency)
 
     # -- scorer-side event feed (KVEventsPool) -------------------------------
@@ -268,7 +285,8 @@ class BlockLifecycleLedger:
             buffered = len(self._ring)
             tracked = len(self._state)
             tracked_evicted = self.tracked_evicted
-        return {
+            tenant_counts = dict(self._tenant_counts)
+        out = {
             "transitions": transitions,
             "buffered": buffered,
             "tracked_blocks": tracked,
@@ -276,6 +294,13 @@ class BlockLifecycleLedger:
             "resident_by_tier": self.resident_by_tier(),
             "transition_counts": self.transition_counts(),
         }
+        if tenant_counts:
+            # Key appears only once a tenant-tagged record landed — i.e.
+            # only with TENANT_QOS on; knob-off snapshots are unchanged.
+            out["tenants"] = {
+                t: n for t, n in sorted(tenant_counts.items())
+            }
+        return out
 
 
 #: reuse-distance histogram bucket upper bounds, in blocks (powers of two:

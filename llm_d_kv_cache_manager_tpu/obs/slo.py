@@ -101,6 +101,7 @@ class SLORecorder:
         on_burn: Optional[Callable[[str, str, float], None]] = None,
         burn_threshold: float = 0.0,
         burn_check_interval_s: float = 1.0,
+        track_tenants: bool = False,
     ):
         """``on_burn(objective, window, rate)`` (optional, e.g. the
         ``OBS_FLIGHT`` recorder's trigger): fired when any objective's
@@ -120,6 +121,15 @@ class SLORecorder:
             o.label: deque(maxlen=max_samples_per_objective)
             for o in self.objectives
         }
+        self._max_samples = max_samples_per_objective
+        #: TENANT_QOS per-tenant burn tracking: (tenant, objective label)
+        #: -> the same deque[(t, violated)] shape as ``_events``. Only
+        #: populated when ``track_tenants`` and the observation carries a
+        #: tenant, so the knob-off recorder holds no extra state. Tenant
+        #: keys are the serving layer's slice keys (bounded by policy
+        #: size), never raw header values.
+        self.track_tenants = bool(track_tenants)
+        self._tenant_events: dict[tuple[str, str], deque] = {}  # guarded_by: _mu
         self.observed = 0  # guarded_by: _mu
         self.on_burn = on_burn
         self.burn_threshold = float(burn_threshold)
@@ -131,12 +141,19 @@ class SLORecorder:
         self.burn_crossings = 0  # guarded_by: _mu
 
     def observe(
-        self, ttft_s: Optional[float], itl_s: Optional[float]
+        self,
+        ttft_s: Optional[float],
+        itl_s: Optional[float],
+        tenant: str = "",
     ) -> None:
         """One finished request's measurements (None = not measurable for
-        this request, e.g. single-token generations have no ITL)."""
+        this request, e.g. single-token generations have no ITL).
+        ``tenant`` slices the same observation per tenant when tenant
+        tracking is on; "" (always, with TENANT_QOS off) changes
+        nothing."""
         now = self._clock()
         values = {"ttft": ttft_s, "itl": itl_s}
+        slice_tenant = tenant if self.track_tenants else ""
         check_burn = False
         with self._mu:
             self.observed += 1
@@ -149,6 +166,15 @@ class SLORecorder:
                 ev.append((now, v > obj.threshold_s))
                 while ev and ev[0][0] < horizon:
                     ev.popleft()
+                if slice_tenant:
+                    tev = self._tenant_events.get((slice_tenant, obj.label))
+                    if tev is None:
+                        tev = self._tenant_events[(slice_tenant, obj.label)] = (
+                            deque(maxlen=self._max_samples)
+                        )
+                    tev.append((now, v > obj.threshold_s))
+                    while tev and tev[0][0] < horizon:
+                        tev.popleft()
             if (
                 self.on_burn is not None
                 and self.burn_threshold > 0
@@ -182,6 +208,48 @@ class SLORecorder:
                     )
                 out[obj.label] = rates
         return out
+
+    def tenant_burn_rates(self) -> dict[str, dict[str, dict[str, Optional[float]]]]:
+        """{tenant: {objective label: {window label: burn rate | None}}}
+        over the per-tenant slices (empty until tenant tracking observed
+        anything). Same arithmetic as ``burn_rates``, same None-for-empty
+        rule."""
+        now = self._clock()
+        with self._mu:
+            slices = {k: list(ev) for k, ev in self._tenant_events.items()}
+        out: dict[str, dict[str, dict[str, Optional[float]]]] = {}
+        by_label = {o.label: o for o in self.objectives}
+        for (tenant, label), ev in sorted(slices.items()):
+            obj = by_label.get(label)
+            if obj is None:
+                continue
+            rates: dict[str, Optional[float]] = {}
+            for w in self.windows_s:
+                cutoff = now - w
+                total = bad = 0
+                for t, violated in reversed(ev):
+                    if t < cutoff:
+                        break
+                    total += 1
+                    bad += violated
+                budget = 1.0 - obj.target
+                rates[f"{w:g}s"] = (
+                    round((bad / total) / budget, 4) if total else None
+                )
+            out.setdefault(tenant, {})[label] = rates
+        return out
+
+    def sync_tenant_gauges(
+        self, set_fn: Callable[[str, str, str, float], None]
+    ) -> None:
+        """Push per-tenant burn rates into labeled gauges
+        (``set_fn(tenant, objective, window, rate)``), skipping empty
+        windows like ``sync_gauges``."""
+        for tenant, objectives in self.tenant_burn_rates().items():
+            for objective, windows in objectives.items():
+                for window, rate in windows.items():
+                    if rate is not None:
+                        set_fn(tenant, objective, window, rate)
 
     def _check_burn_crossings(self) -> None:
         """Edge-triggered burn-threshold detector: fires ``on_burn`` once

@@ -64,6 +64,10 @@ class _PageInfo:
     #: token ids of the full block (kept for BlockStored events)
     token_ids: tuple[int, ...] = ()
     parent_hash: Optional[int] = None
+    #: TENANT_QOS slice the allocating sequence was charged to ("" =
+    #: knob off, or untenanted work like imports). Rides with the block
+    #: across tiers so host-cached pages stay attributed.
+    tenant: str = ""
 
 
 class BlockManager:
@@ -107,6 +111,24 @@ class BlockManager:
         #: walk. Both None (default) = no extra work on any path.
         self._lifecycle = None
         self._mrc = None
+        # -- TENANT_QOS (attach_qos; all None/empty = knob off, every
+        # path below is bit-identical legacy). Engine-thread-only state,
+        # like the page pool itself.
+        self._qos = None
+        #: tenant slice charged for allocations in flight (set at the top
+        #: of allocate/append_slot/reserve_slots from the sequence).
+        self._alloc_tenant = ""
+        #: evictable HBM pages currently charged per tenant slice — the
+        #: numerator of the cache_share cap.
+        self._tenant_evictable: dict[str, int] = {}
+        #: lazily-built per-tenant reuse-distance estimators (the /debug/
+        #: mrc tenant slices); factory installed only when OBS_LIFECYCLE
+        #: is also on.
+        self._tenant_mrc_factory = None
+        self._tenant_mrc: dict = {}
+        #: per-tenant first-prefill hit accounting (requests /
+        #: prompt_tokens / cached_tokens / capped_evictions), for /stats.
+        self.tenant_stats: dict[str, dict[str, int]] = {}
         self._host_free: list[int] = list(range(config.host_pages - 1, -1, -1))
         self._host_cached: dict[int, int] = {}  # chain_hash -> host slot
         self._host_info: dict[int, _PageInfo] = {}  # host slot -> metadata
@@ -157,9 +179,52 @@ class BlockManager:
         self._lifecycle = ledger
         self._mrc = mrc
 
-    def _record_lifecycle(self, chain_hash, tier: str, reason: str) -> None:
+    def attach_qos(self, qos, mrc_factory=None) -> None:
+        """Attach the TENANT_QOS policy (``server/qos.py``): evictable
+        pages are charged to the allocating tenant, tenants over their
+        ``cache_share`` recycle their OWN LRU page instead of other
+        tenants' warm prefixes, and — when ``mrc_factory`` is given
+        (OBS_LIFECYCLE also on) — each tenant slice feeds its own
+        reuse-distance estimator for /debug/mrc."""
+        self._qos = qos
+        self._tenant_mrc_factory = mrc_factory
+
+    def _record_lifecycle(
+        self, chain_hash, tier: str, reason: str, tenant: str = ""
+    ) -> None:
         if self._lifecycle is not None and chain_hash is not None:
-            self._lifecycle.record(chain_hash, tier, reason)
+            self._lifecycle.record(chain_hash, tier, reason, tenant=tenant)
+
+    def _evict_count(self, info: _PageInfo, delta: int) -> None:
+        """Maintain the per-tenant evictable-page counts (no-op with the
+        QoS knob off, and for untenanted pages)."""
+        if self._qos is None or not info.tenant:
+            return
+        n = self._tenant_evictable.get(info.tenant, 0) + delta
+        if n > 0:
+            self._tenant_evictable[info.tenant] = n
+        else:
+            self._tenant_evictable.pop(info.tenant, None)
+
+    def _qos_evict_victim(self) -> Optional[int]:
+        """Cache-share cap (TENANT_QOS): when the allocating tenant's
+        evictable pages already meet its configured share of the pool,
+        the recycle victim is that tenant's own LRU evictable page — its
+        churn cannot evict another tenant's hot prefix. Under the cap
+        (or uncapped, or untenanted) returns None: global LRU applies."""
+        t = self._alloc_tenant
+        if not t:
+            return None
+        cap = self._qos.cache_cap_pages(t, self.config.total_pages - 1)
+        if cap is None or self._tenant_evictable.get(t, 0) < cap:
+            return None
+        for page in self._evictable:  # LRU order
+            if self._pages[page].tenant == t:
+                st = self.tenant_stats.get(t)
+                if st is not None:
+                    st["capped_evictions"] += 1
+                return page
+        return None
 
     @property
     def num_host_cached_pages(self) -> int:
@@ -183,9 +248,13 @@ class BlockManager:
             # demote it instead of losing it. The hook snapshots the slot
             # NOW; the caller reuses it immediately after.
             self._demote(info, "host_dram", slot)
-            self._record_lifecycle(info.chain_hash, "remote", "demote")
+            self._record_lifecycle(
+                info.chain_hash, "remote", "demote", tenant=info.tenant
+            )
         else:
-            self._record_lifecycle(info.chain_hash, "none", "evict")
+            self._record_lifecycle(
+                info.chain_hash, "none", "evict", tenant=info.tenant
+            )
         self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="host_dram"))
         return slot
 
@@ -215,7 +284,9 @@ class BlockManager:
         self._host_cached[info.chain_hash] = slot
         self._host_info[slot] = info
         self._host_lru[slot] = None
-        self._record_lifecycle(info.chain_hash, "host_dram", "spill")
+        self._record_lifecycle(
+            info.chain_hash, "host_dram", "spill", tenant=info.tenant
+        )
         self._emit(
             BlockStored(
                 block_hashes=[info.chain_hash],
@@ -251,14 +322,21 @@ class BlockManager:
     def _pop_free_page(self) -> int:
         if self._free:
             page = self._free.pop()
-            self._pages[page] = _PageInfo(ref_count=1)
+            self._pages[page] = _PageInfo(ref_count=1, tenant=self._alloc_tenant)
             return page
         # Recycle the least-recently-used evictable cached page, spilling
-        # it to the host-DRAM tier first when one is attached.
+        # it to the host-DRAM tier first when one is attached. With
+        # TENANT_QOS cache-share caps, an over-cap tenant recycles its
+        # own LRU page instead (see _qos_evict_victim).
         if self._evictable:
-            page, _ = self._evictable.popitem(last=False)
+            page = self._qos_evict_victim() if self._qos is not None else None
+            if page is None:
+                page, _ = self._evictable.popitem(last=False)
+            else:
+                del self._evictable[page]
             info = self._pages[page]
             assert info.ref_count == 0 and info.chain_hash is not None
+            self._evict_count(info, -1)
             del self._cached[info.chain_hash]
             self._try_offload(page, info)
             if info.chain_hash not in self._host_cached:
@@ -271,18 +349,23 @@ class BlockManager:
                     # device dispatch (the same window the host-tier
                     # offload gather relies on).
                     self._demote(info, "tpu_hbm", page)
-                    self._record_lifecycle(info.chain_hash, "remote", "demote")
+                    self._record_lifecycle(
+                        info.chain_hash, "remote", "demote", tenant=info.tenant
+                    )
                 else:
-                    self._record_lifecycle(info.chain_hash, "none", "evict")
+                    self._record_lifecycle(
+                        info.chain_hash, "none", "evict", tenant=info.tenant
+                    )
             self._emit(BlockRemoved(block_hashes=[info.chain_hash], medium="tpu_hbm"))
-            self._pages[page] = _PageInfo(ref_count=1)
+            self._pages[page] = _PageInfo(ref_count=1, tenant=self._alloc_tenant)
             return page
         raise AllocationError("KV page pool exhausted")
 
     def _incref(self, page: int) -> None:
         info = self._pages[page]
-        if info.ref_count == 0:
-            self._evictable.pop(page, None)
+        if info.ref_count == 0 and page in self._evictable:
+            del self._evictable[page]
+            self._evict_count(info, -1)
         info.ref_count += 1
 
     def _decref(self, page: int) -> None:
@@ -294,6 +377,7 @@ class BlockManager:
                 # Stays cached & evictable: warm for future prefix hits.
                 self._evictable[page] = None
                 self._evictable.move_to_end(page)
+                self._evict_count(info, +1)
             else:
                 del self._pages[page]
                 self._free.append(page)
@@ -329,7 +413,8 @@ class BlockManager:
         self._pages[page] = info
         self._cached[h] = page
         self._evictable[page] = None  # ref 0 until the caller increfs
-        self._record_lifecycle(h, "tpu_hbm", reason)
+        self._evict_count(info, +1)
+        self._record_lifecycle(h, "tpu_hbm", reason, tenant=info.tenant)
         self._emit(BlockRemoved(block_hashes=[h], medium="host_dram"))
         self._emit(
             BlockStored(
@@ -481,6 +566,9 @@ class BlockManager:
         if self._free:
             page = self._free.pop()
         elif allow_evict:
+            # Imports are fleet warmth, not tenant work: never charge
+            # them to (or cap them by) whatever tenant allocated last.
+            self._alloc_tenant = ""
             page = self._pop_free_page()  # recycles LRU; victim spills/demotes
         else:
             raise AllocationError("no free pages for imported KV block")
@@ -494,6 +582,7 @@ class BlockManager:
         self._cached[h] = page
         self._evictable[page] = None
         self._evictable.move_to_end(page)
+        self._evict_count(info, +1)
         self._record_lifecycle(h, "tpu_hbm", "import")
         self._emit(
             BlockStored(
@@ -512,19 +601,31 @@ class BlockManager:
         pages. Sets ``seq.block_table`` / ``seq.num_cached_prompt``; returns
         the number of prompt tokens served from cache."""
         assert not seq.block_table, "sequence already allocated"
+        self._alloc_tenant = seq.tenant
         tokens = seq.prompt_tokens
         ps = self.config.page_size
         hashes = self.token_db.prefix_hashes(tokens)
-        if self._mrc is not None and not seq.mrc_observed:
+        observe_tenant = (
+            self._tenant_mrc_factory is not None and bool(seq.tenant)
+        )
+        if (self._mrc is not None or observe_tenant) and not seq.mrc_observed:
             # The MRC's access stream: every full block this lookup walks
             # — hits AND misses (the misses register below and become
             # future reuse), in chain order. Once per REQUEST, not per
             # allocate call: rollback retries and preemption re-prefills
             # re-walk the same chain, and double-observing it would feed
             # tiny artificial reuse distances (the hit_stats
-            # first-prefill-only rule, applied to the curve).
+            # first-prefill-only rule, applied to the curve). The tenant
+            # slices (TENANT_QOS + OBS_LIFECYCLE) see the same stream,
+            # restricted to their own requests.
             seq.mrc_observed = True
-            self._mrc.observe_chain(hashes)
+            if self._mrc is not None:
+                self._mrc.observe_chain(hashes)
+            if observe_tenant:
+                est = self._tenant_mrc.get(seq.tenant)
+                if est is None:
+                    est = self._tenant_mrc[seq.tenant] = self._tenant_mrc_factory()
+                est.observe_chain(hashes)
 
         block_table: list[int] = []
         cached_tokens = 0
@@ -585,6 +686,23 @@ class BlockManager:
         seq.last_chain_hash = (
             self._pages[block_table[n_reused - 1]].chain_hash if n_reused else None
         )
+        if self._qos is not None and seq.tenant and not seq.qos_observed:
+            # Per-tenant hit accounting, first successful prefill only
+            # (the hit_stats rule): rollbacks raise above, preemption
+            # re-prefills have qos_observed already set.
+            seq.qos_observed = True
+            st = self.tenant_stats.setdefault(
+                seq.tenant,
+                {
+                    "requests": 0,
+                    "prompt_tokens": 0,
+                    "cached_tokens": 0,
+                    "capped_evictions": 0,
+                },
+            )
+            st["requests"] += 1
+            st["prompt_tokens"] += len(tokens)
+            st["cached_tokens"] += cached_tokens
         return cached_tokens
 
     def can_allocate(self, seq: Sequence) -> bool:
@@ -597,6 +715,7 @@ class BlockManager:
         page when the sequence crosses a page boundary."""
         ps = self.config.page_size
         if seq.num_tokens > len(seq.block_table) * ps:
+            self._alloc_tenant = seq.tenant
             seq.block_table.append(self._pop_free_page())
 
     def reserve_slots(self, seq: Sequence, n: int) -> None:
@@ -607,6 +726,7 @@ class BlockManager:
         kept (the caller's preempt-and-retry loop continues from it)."""
         ps = self.config.page_size
         needed = -(-(seq.num_tokens + n - 1) // ps)
+        self._alloc_tenant = seq.tenant
         while len(seq.block_table) < needed:
             seq.block_table.append(self._pop_free_page())
 
@@ -643,8 +763,9 @@ class BlockManager:
                 info.chain_hash = h
                 info.token_ids = block
                 info.parent_hash = parent if i > 0 else None
+                info.tenant = seq.tenant
                 self._cached[h] = page
-                self._record_lifecycle(h, "tpu_hbm", "allocate")
+                self._record_lifecycle(h, "tpu_hbm", "allocate", tenant=seq.tenant)
                 self._emit(
                     BlockStored(
                         block_hashes=[h],

@@ -1297,11 +1297,19 @@ class Engine:
         sampling: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         deadline: Optional[float] = None,
+        tenant: str = "",
+        priority: int = 0,
+        qos_weight: float = 1.0,
     ) -> Sequence:
         """``deadline``: absolute ``time.monotonic()`` deadline. Expired
         waiting sequences are shed before prefill; running sequences past
         it finish early with ``finish_reason="deadline"``. None (default)
-        = no deadline, bit-identical legacy behavior."""
+        = no deadline, bit-identical legacy behavior.
+
+        ``tenant``/``priority``/``qos_weight``: TENANT_QOS dimension
+        (serving layer resolves them from the parsed policy). Defaults =
+        knob off — every sequence shares one anonymous class and the
+        scheduler's QoS ordering never fires."""
         if len(prompt_tokens) == 0:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.config.max_model_len:
@@ -1319,6 +1327,9 @@ class Engine:
             sampling=sampling or SamplingParams(),
             request_id=request_id,
             deadline=deadline,
+            tenant=tenant,
+            priority=priority,
+            qos_weight=qos_weight,
         )
         if deadline is not None:
             self._deadlines_used = True
@@ -1509,6 +1520,12 @@ class Engine:
                 seq.finish_time = now
                 self.lifecycle_stats["deadline_shed"] += 1
                 self.finished.append(seq)
+        if self.scheduler.qos_enabled:
+            # TENANT_QOS priority preemption BEFORE scheduling: when the
+            # highest-class waiting prefill cannot allocate, free pages by
+            # preempting one strictly lower-class active sequence so the
+            # schedule below can admit it.
+            self._preempt_for_priority()
         if self.config.host_prefetch and self.config.block_manager.host_pages:
             # Host-tier prefetch AHEAD of the scheduler: waiting sequences'
             # host-cached prefixes start their device↔host copies now, so
@@ -2244,6 +2261,15 @@ class Engine:
         ]
         if not candidates:
             return None
+        if self.scheduler.qos_enabled:
+            # TENANT_QOS: prefer victims from a strictly lower priority
+            # class than the sequence that needs pages; fall back to the
+            # full candidate set so growth never wedges just because only
+            # same-or-higher-class work is active. The recency/cost policy
+            # below then runs unchanged within the preferred set.
+            lower = [c for c in candidates if c.priority > seq.priority]
+            if lower:
+                candidates = lower
         if (
             self.config.block_manager.host_pages > 0
             and self._prefill_rate is not None
@@ -2282,6 +2308,55 @@ class Engine:
                 self.block_manager.free_sequence(victim)
                 victim.fold_for_preemption()
                 self.scheduler.waiting.appendleft(victim)
+
+    def _preempt_for_priority(self) -> None:
+        """TENANT_QOS priority preemption: when the highest-class waiting
+        sequence cannot allocate its prefill pages, preempt ONE strictly
+        lower-class active sequence (the shared recompute-fold machinery —
+        its pages are freed, surviving prefix-cache pages make the
+        re-prefill cheap, and it re-queues WAITING, never errored). One
+        victim per step bounds the blast radius: a page-starved pool
+        degrades the background class gradually instead of folding every
+        low-class lane at once and thrashing."""
+        sch = self.scheduler
+        sch.qos_reorder_waiting()
+        head = next((s for s in sch.waiting if not s.importing), None)
+        if head is None or self.block_manager.can_allocate(head):
+            return
+        candidates = [
+            cand
+            for cand in list(reversed(sch.running))
+            + list(reversed(sch.prefilling))
+            if cand.priority > head.priority and not self._should_finish(cand)
+        ]
+        if not candidates:
+            return
+        # Worst class first; within it, most recently admitted (least
+        # progress lost) — max() returns the first maximum, and the lists
+        # above are already most-recent-first.
+        victim = max(candidates, key=lambda c: c.priority)
+        if self._inflight is not None and any(
+            s is victim for s in self._inflight["active"]
+        ):
+            self._drain_inflight()
+        log.warning(
+            "priority preemption",
+            victim=victim.seq_id,
+            victim_tenant=victim.tenant,
+            for_seq=head.seq_id,
+            for_tenant=head.tenant,
+        )
+        sch.on_preempted(victim)
+        self.block_manager.free_sequence(victim)
+        victim.fold_for_preemption()
+        sch.waiting.append(victim)  # reorder places it by class next walk
+        # .get()-style bump: the key appears in lifecycle_stats (and thus
+        # in the /stats admission block, which spreads this dict) only
+        # once a preemption actually happened — i.e. only with TENANT_QOS
+        # on, preserving knobs-off /stats parity.
+        self.lifecycle_stats["priority_preempted"] = (
+            self.lifecycle_stats.get("priority_preempted", 0) + 1
+        )
 
     def _sample(self, logits: jnp.ndarray, seqs: list[Sequence]) -> np.ndarray:
         b = logits.shape[0]

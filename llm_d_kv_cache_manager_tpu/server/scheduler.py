@@ -76,6 +76,48 @@ class Scheduler:
         #: admitted (pages allocated) but only partially prefilled — only
         #: populated in chunked mode; FCFS order preserved.
         self.prefilling: list[Sequence] = []
+        #: TENANT_QOS (off by default): when enabled, the waiting queue is
+        #: re-ordered by (priority class, weighted-fair served tokens)
+        #: before each admission walk. Engine-thread-only state.
+        self.qos_enabled: bool = False
+        #: prefill tokens served per tenant slice, divided by the tenant's
+        #: weight at comparison time — the weighted-fair tiebreak within a
+        #: priority class (lowest normalized share admits first, bounding
+        #: starvation between same-class tenants).
+        self._qos_served: dict[str, float] = {}
+
+    def attach_qos(self) -> None:
+        """Enable TENANT_QOS ordering (serving layer calls this once at
+        construction, before the engine thread starts)."""
+        self.qos_enabled = True
+
+    def _qos_sort_key(self, seq: Sequence) -> tuple[int, float]:
+        served = self._qos_served.get(seq.tenant, 0.0)
+        return (seq.priority, served / max(seq.qos_weight, 1e-9))
+
+    def qos_reorder_waiting(self) -> None:
+        """Stable-sort the waiting queue by (priority class, normalized
+        served tokens). Stability keeps FIFO order within a tenant and
+        between tenants with equal shares, so the legacy FCFS admission
+        walks below run unmodified — their head-of-queue break rule then
+        protects the highest-priority request instead of the oldest."""
+        if not self.qos_enabled or len(self.waiting) <= 1:
+            return
+        self.waiting = deque(sorted(self.waiting, key=self._qos_sort_key))
+
+    def _qos_charge(self, seq: Sequence, tokens: int) -> None:
+        """Charge admitted prefill tokens to the tenant's fair-share
+        meter. Occasionally renormalized (only relative shares matter)
+        so the floats never grow without bound."""
+        if not self.qos_enabled or tokens <= 0:
+            return
+        served = self._qos_served
+        served[seq.tenant] = served.get(seq.tenant, 0.0) + float(tokens)
+        if len(served) > 1:
+            floor = min(served.values())
+            if floor >= 1e9:
+                for k in served:
+                    served[k] -= floor
 
     def add(self, seq: Sequence) -> None:
         seq.status = SequenceStatus.WAITING
@@ -130,12 +172,21 @@ class Scheduler:
         ):
             keep: deque[Sequence] = deque()
             for seq in self.waiting:
+                if seq.is_finished():
+                    # Defensive: a sequence that already finished (aborted
+                    # or shed elsewhere after a preemption re-queued it)
+                    # is dropped without re-counting — one shed per
+                    # request, the counters stay exact.
+                    continue
                 if seq.deadline is not None and now >= seq.deadline:
                     shed.append(seq)
                 else:
                     keep.append(seq)
             self.waiting = keep
         for seq in list(self.prefilling):
+            if seq.is_finished():
+                self.prefilling.remove(seq)
+                continue
             if seq.deadline is not None and now >= seq.deadline:
                 self.prefilling.remove(seq)
                 self.block_manager.free_sequence(seq)
@@ -143,7 +194,8 @@ class Scheduler:
                 shed.append(seq)
         for seq in shed:
             seq.status = SequenceStatus.FINISHED
-            seq.finish_reason = "deadline"
+            if seq.finish_reason is None:
+                seq.finish_reason = "deadline"
             log.warning(
                 "shedding deadline-expired request before prefill",
                 seq=seq.seq_id,
@@ -155,6 +207,7 @@ class Scheduler:
         """Pick the work for one engine step."""
         if self.config.chunked_prefill_tokens is not None:
             return self._schedule_chunked()
+        self.qos_reorder_waiting()
         # Admit waiting sequences first (prefill priority). Sequences
         # whose async KV-pull is still importing are skipped in place
         # (admission continues past them — the wire must never stall
@@ -187,6 +240,7 @@ class Scheduler:
                 break
             del self.waiting[idx]
             budget -= suffix
+            self._qos_charge(seq, suffix)
             prefill.append(seq)
 
         if prefill:
@@ -205,6 +259,7 @@ class Scheduler:
     def _schedule_chunked(self) -> ScheduleOutput:
         """Token-budget mixed step: prefill chunks up to the budget plus
         every running decode lane."""
+        self.qos_reorder_waiting()
         align = max(1, self.config.chunk_align)
         # A budget below one alignment unit could never form a non-final
         # chunk; the align clamp is applied LAST (also overriding
@@ -228,6 +283,7 @@ class Scheduler:
                 break
             prefill.append(seq)
             chunks.append(take)
+            self._qos_charge(seq, take)
             budget -= take
 
         # Then admit new sequences under the page-budget/FCFS rules
@@ -260,6 +316,7 @@ class Scheduler:
             self.prefilling.append(seq)
             prefill.append(seq)
             chunks.append(take)
+            self._qos_charge(seq, take)
             budget -= take
 
         return ScheduleOutput(

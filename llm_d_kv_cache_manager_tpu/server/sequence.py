@@ -107,6 +107,21 @@ class Sequence:
     #: tiny artificial reuse distances and bias the curve upward, the
     #: same reason ``hit_stats`` snapshots only the first prefill.
     mrc_observed: bool = False
+    #: TENANT_QOS slice key this request is charged to ("" = knob off,
+    #: no tenant dimension anywhere). Unknown tenants are collapsed onto
+    #: the "*" slice by the serving layer before the sequence is built.
+    tenant: str = ""
+    #: TENANT_QOS priority class (0 = highest). The scheduler orders the
+    #: waiting queue by class and preemption only takes pages from a
+    #: strictly lower class. 0 for every sequence when the knob is off,
+    #: so ordering is a no-op.
+    priority: int = 0
+    #: TENANT_QOS weighted-fair share within the class (> 0).
+    qos_weight: float = 1.0
+    #: per-tenant hit-stats bookkeeping: True once this request's first
+    #: successful allocation has been counted (same first-prefill-only
+    #: rationale as ``mrc_observed``).
+    qos_observed: bool = False
 
     def __post_init__(self):
         if self.user_prompt_len < 0:

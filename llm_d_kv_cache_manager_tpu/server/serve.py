@@ -89,18 +89,40 @@ class DrainingError(RuntimeError):
     rolling restart — clients should retry against another pod (503)."""
 
 
+def admission_reject_response(web, err: AdmissionError):
+    """The one 429 shape for every admission-reject site: the JSON body
+    carries the float hint verbatim; the ``Retry-After`` header is the
+    hint rounded UP to whole seconds (RFC 9110 allows only integers) and
+    floored at 1 — truncation would turn a 0.2 s hint into ``0``, an
+    immediate-retry invitation to the exact client being shed.
+    ``web`` is the caller's ``aiohttp.web`` module (imported lazily by
+    the HTTP surface, so this helper takes it rather than importing)."""
+    retry_after = max(int(-(-err.retry_after_s // 1)), 1)
+    return web.json_response(
+        {"error": str(err), "retry_after_s": err.retry_after_s},
+        status=429,
+        headers={"Retry-After": str(retry_after)},
+    )
+
+
 class _ServingMetrics:
     """Prometheus serving metrics (the pod-side analogue of the indexer's
     collector): request/token counters, prefix-cache savings, TTFT histogram.
     Inert when prometheus_client is unavailable."""
 
-    def __init__(self, obs: bool = False, lifecycle: bool = False):
+    def __init__(
+        self,
+        obs: bool = False,
+        lifecycle: bool = False,
+        tenant_qos: bool = False,
+    ):
         """``obs``: build the PR-5 latency-decomposition histograms and
         engine-step telemetry series (``OBS_METRICS``). ``lifecycle``:
         build the ISSUE 15 block-lifecycle families (tier transitions,
         per-tier residency, reuse distance — fed by the ``OBS_LIFECYCLE``
-        ledger/estimator). Both off (default) keeps the exposition
-        surface bit-identical to previous rounds."""
+        ledger/estimator). ``tenant_qos``: build the tenant-labeled SLO
+        burn gauge (``TENANT_QOS`` + ``OBS_SLO``). All off (default)
+        keeps the exposition surface bit-identical to previous rounds."""
         # Measured serving rates (EMAs over request completions), kept
         # OUTSIDE the prometheus guard: admission control derives its
         # Retry-After hint from them, with or without prometheus_client.
@@ -109,6 +131,7 @@ class _ServingMetrics:
         self._last_finish: Optional[float] = None
         self._obs = bool(obs)
         self._lifecycle = bool(lifecycle)
+        self._tenant_qos = bool(tenant_qos)
         try:
             import prometheus_client as prom
         except ImportError:  # pragma: no cover
@@ -363,6 +386,20 @@ class _ServingMetrics:
                     float(b) for b in lifecycle_mod.REUSE_DISTANCE_BUCKETS
                 ),
             )
+        # Tenant-sliced SLO burn (TENANT_QOS): same arithmetic as
+        # kvcache_slo_burn_rate over the recorder's per-tenant slices.
+        # Built only under the tenant knob so the default exposition
+        # surface stays unchanged; tenant label values are the serving
+        # layer's bounded slice keys, never raw header values.
+        if self._tenant_qos:
+            self.tenant_slo_burn = prom.Gauge(
+                "kvcache_tenant_slo_burn_rate",
+                "Error-budget burn rate per tenant, OBS_SLO objective and "
+                "sliding window (the per-tenant slice of "
+                "kvcache_slo_burn_rate; 1.0 = budget burns at exactly its "
+                "sustainable rate)",
+                ["tenant", "objective", "window"], registry=self.registry,
+            )
 
     def observe_tier_transition(self, frm: str, to: str, reason: str) -> None:
         if self._prom is None or not self._lifecycle:
@@ -387,6 +424,15 @@ class _ServingMetrics:
         if self._prom is None or not self._obs:
             return
         self.slo_burn.labels(objective=objective, window=window).set(rate)
+
+    def set_tenant_slo_burn(
+        self, tenant: str, objective: str, window: str, rate: float
+    ) -> None:
+        if self._prom is None or not self._tenant_qos:
+            return
+        self.tenant_slo_burn.labels(
+            tenant=tenant, objective=objective, window=window
+        ).set(rate)
 
     def observe_pull(self, seconds: float, outcome: str) -> None:
         """One ``pull_prefix`` attempt: outcome ok (imported >= 1 block),
@@ -755,6 +801,19 @@ class PodServerConfig:
     #: (default) answers migrations with the same tolerant refusal a
     #: legacy service gives, and ``migrate_out`` refuses locally.
     fleet_controller: bool = False
+    # -- multi-tenant QoS (ISSUE 18; off by default = bit-identical legacy
+    # -- behavior, /stats fields, and wire bytes) ---------------------------
+    #: ``TENANT_QOS`` policy spec (see server/qos.py for the grammar):
+    #: semicolon-separated ``name:prio=..,weight=..,max_waiting=..,
+    #: max_queued_tokens=..,rps=..,cache_share=..`` entries; ``*`` is the
+    #: default tenant. Set = requests are sliced by the ``X-Tenant``
+    #: header: per-tenant admission budgets (429 + Retry-After),
+    #: priority-ordered scheduling with cross-class preemption,
+    #: weighted-fair token shares within a class, per-tenant
+    #: evictable-page caps, and tenant-sliced observability (ledger
+    #: rows, MRC slices, SLO burn rates). Unset (default) = no tenant
+    #: dimension anywhere: bit-identical legacy behavior.
+    tenant_qos: str = ""
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -856,6 +915,8 @@ class PodServerConfig:
         )
         # Fleet controller (ISSUE 17; 0/unset = off, legacy behavior).
         cfg.fleet_controller = _env_bool("FLEET_CONTROLLER", "0")
+        # Multi-tenant QoS (ISSUE 18; unset/empty = off, legacy behavior).
+        cfg.tenant_qos = os.environ.get("TENANT_QOS", cfg.tenant_qos)
 
         eng = cfg.engine
         eng.block_manager = BlockManagerConfig(
@@ -1006,7 +1067,7 @@ class PodServer:
         self._mu = threading.Lock()
         self._work = threading.Condition(self._mu)
         #: staged request tuples: (tokens, sampling, deadline, rid,
-        #: future, span, route_action, pull_source)
+        #: future, span, route_action, pull_source, tenant_key)
         self._staging: deque[tuple] = deque()  # guarded_by: _mu|_work
         self._futures: dict[int, Future] = {}  # loop-thread-only
         #: staged aborts: (request_id | None = all, future -> bool)
@@ -1026,7 +1087,32 @@ class PodServer:
         self.metrics = _ServingMetrics(
             obs=self.config.obs_metrics,
             lifecycle=self.config.obs_lifecycle,
+            tenant_qos=bool(self.config.tenant_qos.strip()),
         )
+        # -- multi-tenant QoS (ISSUE 18; off = None, no hooks anywhere) ----
+        #: parsed TENANT_QOS policy table + per-tenant admission budgets.
+        #: A malformed spec raises HERE, at construction — a silently
+        #: dropped tenant entry would read as an unbudgeted tenant.
+        self.qos = None
+        if self.config.tenant_qos.strip():
+            from .qos import TenantQoS, parse_tenant_qos
+
+            self.qos = TenantQoS(parse_tenant_qos(self.config.tenant_qos))
+            # Priority ordering + weighted-fair shares in the scheduler,
+            # per-tenant page accounting + evictable-share caps in the
+            # block manager (both engine-thread-only state).
+            self.engine.scheduler.attach_qos()
+            self.engine.block_manager.attach_qos(
+                self.qos,
+                # Per-tenant MRC slices ride the OBS_LIFECYCLE knob: each
+                # tenant's allocate-time chains feed its own estimator
+                # (same sampling knobs as the global curve).
+                mrc_factory=(
+                    self._make_tenant_mrc
+                    if self.config.obs_lifecycle
+                    else None
+                ),
+            )
         # -- KV-capacity observability (ISSUE 15; off = None, no hooks) ----
         #: block-lifecycle ledger + reuse-distance MRC (OBS_LIFECYCLE)
         self.lifecycle = None
@@ -1133,6 +1219,10 @@ class PodServer:
                     if self.flight is not None
                     else 0.0
                 ),
+                # Per-tenant burn slices (TENANT_QOS): same observations,
+                # sliced by the request's tenant key. Off = the recorder
+                # holds no tenant state.
+                track_tenants=self.qos is not None,
             )
 
         # -- fleet self-healing (heartbeats + periodic resync) --------------
@@ -1422,9 +1512,13 @@ class PodServer:
             self._pull_jobs.clear()
             self._pending = 0
             self._pending_tokens = 0
+            if self.qos is not None:
+                # Per-tenant budgets mirror the shared counters: nothing
+                # outstanding survives an engine failure.
+                self.qos.reset_pending()
         for job in jobs:
             job["cancel"].set()
-        for _, _, _, _, fut, span, _, _ in staged:
+        for _, _, _, _, fut, span, _, _, _ in staged:
             span.set_attr("error", str(exc))
             span.end()
             if not fut.done():
@@ -1441,11 +1535,15 @@ class PodServer:
                 fut.set_exception(exc)
         self._futures.clear()
 
-    def _forget_pending(self, n_tokens: int) -> None:
-        """Release one request's admission accounting (engine loop only)."""
+    def _forget_pending(self, n_tokens: int, tenant: str = "") -> None:
+        """Release one request's admission accounting (engine loop only).
+        ``tenant`` releases the same request's per-tenant budget when
+        TENANT_QOS is on ("" = untenanted, nothing to release)."""
         with self._mu:
             self._pending = max(self._pending - 1, 0)
             self._pending_tokens = max(self._pending_tokens - n_tokens, 0)
+            if self.qos is not None and tenant:
+                self.qos.on_resolved(tenant, n_tokens)
 
     def _resolve(self, seq: Sequence) -> None:
         """Resolve a finished/aborted sequence's future and release its
@@ -1464,7 +1562,7 @@ class PodServer:
             # Same measurements the latency histograms observe (the
             # shared Sequence.ttft/mean_itl definitions), so the burn
             # rate stays a faithful in-process cross-check of them.
-            self.slo.observe(seq.ttft, seq.mean_itl)
+            self.slo.observe(seq.ttft, seq.mean_itl, tenant=seq.tenant)
         if seq.trace_span is not None:
             self._emit_request_spans(seq)
         if (
@@ -1523,7 +1621,7 @@ class PodServer:
                 log.exception("PrefillComplete publish failed")
         fut = self._futures.pop(seq.seq_id, None)
         if fut is not None:
-            self._forget_pending(seq.user_prompt_len)
+            self._forget_pending(seq.user_prompt_len, seq.tenant)
             if not fut.done():
                 fut.set_result(seq)
 
@@ -1576,6 +1674,19 @@ class PodServer:
         span.end(end_mono=end)
 
     # -- flight recorder (OBS_FLIGHT) ----------------------------------------
+    def _make_tenant_mrc(self):
+        """Factory for one tenant's reuse-distance estimator (TENANT_QOS
+        + OBS_LIFECYCLE): same sampling knobs as the global curve, but no
+        ``on_distance`` hook — the global estimator already feeds the
+        reuse-distance histogram, and a second feed would double-count
+        every sampled access."""
+        from ..obs.lifecycle import ReuseDistanceEstimator
+
+        return ReuseDistanceEstimator(
+            sample_rate=self.config.obs_mrc_sample,
+            max_tracked=self.config.obs_mrc_tracked,
+        )
+
     def _on_slo_burn(self, objective: str, window: str, rate: float) -> None:
         """SLORecorder burn-crossing callback: the flight recorder's
         primary trigger. The burn sample itself rides the timeline, so a
@@ -1707,13 +1818,28 @@ class PodServer:
                         fut.set_result(call())
                     except Exception as e:
                         fut.set_exception(e)
-                for tokens, sampling, deadline, rid, fut, span, action, pull in staged:
+                for (
+                    tokens, sampling, deadline, rid, fut, span, action,
+                    pull, tenant,
+                ) in staged:
                     try:
-                        seq = self.engine.add_request(
-                            tokens, sampling, request_id=rid, deadline=deadline
-                        )
+                        if self.qos is not None:
+                            # The policy's class/weight ride the Sequence
+                            # into the scheduler and block manager.
+                            pol = self.qos.policy(tenant)
+                            seq = self.engine.add_request(
+                                tokens, sampling, request_id=rid,
+                                deadline=deadline, tenant=tenant,
+                                priority=pol.priority,
+                                qos_weight=pol.weight,
+                            )
+                        else:
+                            seq = self.engine.add_request(
+                                tokens, sampling, request_id=rid,
+                                deadline=deadline,
+                            )
                     except ValueError as e:
-                        self._forget_pending(len(tokens))
+                        self._forget_pending(len(tokens), tenant)
                         span.set_attr("error", str(e))
                         span.end()
                         # done() guard: a disconnected client may have
@@ -2675,12 +2801,38 @@ class PodServer:
             est = queued_tokens / self.engine._prefill_rate
         return float(min(max(est if est is not None else 1.0, 1.0), 60.0))
 
-    def _check_admission(self, n_tokens: int) -> None:  # kvlint: holds=_work
+    def _check_admission(  # kvlint: holds=_work
+        self, n_tokens: int, tenant: str = ""
+    ) -> None:
         """Admission control (caller holds ``_mu``): reject fast — before
         the request touches the engine — when the configured queue-depth or
-        queued-token cap would be exceeded. Both caps off (0) = legacy
-        unbounded admission."""
+        queued-token cap would be exceeded. ``tenant`` is the request's
+        QoS slice key; with TENANT_QOS on its per-tenant budgets
+        (max_waiting / max_queued_tokens / rps) are checked FIRST — a
+        tenant over ITS budget gets the tenant-shaped 429 even when the
+        pod as a whole has headroom. Both caps off (0) and no QoS policy
+        = legacy unbounded admission."""
         cfg = self.config
+        if self.qos is not None:
+            verdict = self.qos.admit(tenant, n_tokens)
+            if verdict is not None:
+                cap, message, rate_hint, t_depth, t_queued = verdict
+                self.admission_rejected += 1
+                self.metrics.observe_rejected(draining=False)
+                self._flight_event(
+                    "admission_reject", cap=f"tenant_{cap}", tenant=tenant
+                )
+                # Rate rejections carry an exact hint (when the oldest
+                # window event expires); budget rejections fall back to
+                # the measured-rate estimate over the tenant's own queue.
+                raise AdmissionError(
+                    message,
+                    (
+                        rate_hint
+                        if rate_hint is not None
+                        else self._retry_after_s(t_depth, t_queued)
+                    ),
+                )
         if cfg.admission_max_waiting <= 0 and cfg.admission_max_queued_tokens <= 0:
             return
         sch = self.engine.scheduler
@@ -2724,6 +2876,7 @@ class PodServer:
         trace_ctx=None,
         route_action: Optional[str] = None,
         pull_source: Optional[str] = None,
+        tenant: str = "",
     ) -> Future:
         """Enqueue a request; the Future resolves to the finished Sequence
         (or raises: invalid request, engine failure, shutdown). Raises
@@ -2743,7 +2896,12 @@ class PodServer:
         a worker fetches the chain in the background (the scheduler
         admits it once the blocks land, or on any fetch failure — cold
         prefill). With the knob off the argument is ignored; callers use
-        the legacy blocking ``pull_prefix``-then-``submit`` flow."""
+        the legacy blocking ``pull_prefix``-then-``submit`` flow.
+        ``tenant``: the request's tenant name (the ``X-Tenant`` header).
+        With ``TENANT_QOS`` on it is collapsed onto a policy slice key
+        and drives per-tenant admission budgets, priority scheduling,
+        cache accounting and observability slices; with the knob off
+        (the default) the argument is ignored."""
         # Surface obviously-bad requests synchronously with the same checks
         # add_request applies (the rest raise through the Future).
         if not prompt_tokens:
@@ -2774,6 +2932,10 @@ class PodServer:
             else None
         )
         rid = request_id or str(uuid.uuid4())
+        # Collapse the raw tenant header onto a policy slice key up front:
+        # every downstream consumer (budgets, scheduler, block manager,
+        # observability) sees only bounded key-space values.
+        tkey = self.qos.key(tenant) if self.qos is not None else ""
         fut: Future = Future()
         fut.request_id = rid
         # Span starts at submit (queueing time includes staging), after the
@@ -2791,7 +2953,7 @@ class PodServer:
                 raise DrainingError(
                     "pod is draining; retry against another pod"
                 )
-            self._check_admission(len(prompt_tokens))
+            self._check_admission(len(prompt_tokens), tkey)
             if clamped:
                 self.role_clamped_requests += 1
             span = self.tracer.start_span(
@@ -2806,6 +2968,8 @@ class PodServer:
             fut.trace_context = span.context
             self._pending += 1
             self._pending_tokens += len(prompt_tokens)
+            if self.qos is not None:
+                self.qos.on_admitted(tkey, len(prompt_tokens))
             pull = (
                 pull_source
                 if pull_source and self.config.async_pull
@@ -2813,7 +2977,7 @@ class PodServer:
             )
             self._staging.append(
                 (list(prompt_tokens), sampling, deadline, rid, fut, span,
-                 route_action, pull)
+                 route_action, pull, tkey)
             )
             self._work.notify()
         return fut
@@ -2932,6 +3096,15 @@ class PodServer:
                 if self.config.async_pull
                 else None
             )
+            # Tenant identity (X-Tenant): read only with TENANT_QOS on —
+            # the knobs-off request path touches no headers it didn't
+            # before. Unknown/absent tenants collapse onto the "*" policy
+            # entry inside submit.
+            tenant = (
+                request.headers.get("X-Tenant", "")
+                if self.qos is not None
+                else ""
+            )
             try:
                 fut = self.submit(
                     token_ids,
@@ -2940,14 +3113,10 @@ class PodServer:
                     trace_ctx=trace_ctx,
                     route_action=route_action,
                     pull_source=pull_source,
+                    tenant=tenant,
                 )
             except AdmissionError as e:  # overloaded: fast 429, engine untouched
-                retry_after = max(int(-(-e.retry_after_s // 1)), 1)
-                return web.json_response(
-                    {"error": str(e), "retry_after_s": e.retry_after_s},
-                    status=429,
-                    headers={"Retry-After": str(retry_after)},
-                )
+                return admission_reject_response(web, e)
             except DrainingError as e:  # rolling restart: go elsewhere
                 return web.json_response({"error": str(e)}, status=503)
             except ValueError as e:
@@ -3087,6 +3256,9 @@ class PodServer:
                 demote_dropped = self.demote_dropped
                 demote_queued = len(self._demote_queue)
                 peer_headroom = dict(self._peer_headroom)
+                tenant_qos_snap = (
+                    self.qos.snapshot() if self.qos is not None else None
+                )
             payload = {
                 "pod": self.config.pod_identifier,
                 "model": self.config.model_name,
@@ -3230,6 +3402,25 @@ class PodServer:
                 # Flight block only with the knob on: the knobs-off
                 # /stats payload stays bit-identical.
                 payload["flight"] = self.flight.snapshot()
+            if self.qos is not None:
+                # Tenant-QoS block only with the knob on: the knobs-off
+                # /stats payload stays bit-identical. Scheduler/block-
+                # manager tenant state is engine-thread-owned; these are
+                # the same tolerated point-in-time reads as the queue
+                # depths above.
+                sch = self.engine.scheduler
+                tenant_qos_snap["qos_served_tokens"] = {
+                    t: round(v, 1) for t, v in dict(sch._qos_served).items()
+                }
+                tenant_qos_snap["cache"] = {
+                    "evictable_pages": dict(bm._tenant_evictable),
+                    "stats": {
+                        t: dict(s) for t, s in dict(bm.tenant_stats).items()
+                    },
+                }
+                if self.slo is not None:
+                    tenant_qos_snap["slo_burn"] = self.slo.tenant_burn_rates()
+                payload["tenant_qos"] = tenant_qos_snap
             if self.config.fleet_controller:
                 # Fleet block only with the knob on: the knobs-off
                 # /stats payload stays bit-identical.
@@ -3259,6 +3450,10 @@ class PodServer:
                 # Scrape-driven: burn rates recompute here, like the
                 # indexer's occupancy gauges.
                 self.slo.sync_gauges(self.metrics.set_slo_burn)
+                if self.qos is not None:
+                    self.slo.sync_tenant_gauges(
+                        self.metrics.set_tenant_slo_burn
+                    )
             body = self.metrics.exposition()
             if body is None:
                 return web.json_response(
@@ -3299,9 +3494,20 @@ class PodServer:
                 caps["tpu_hbm+host_dram"] = (
                     bm_cfg.total_pages - 1 + bm_cfg.host_pages
                 )
-            return web.json_response(
-                debug_mrc_payload(self.mrc, tier_capacities=caps)
-            )
+            payload = debug_mrc_payload(self.mrc, tier_capacities=caps)
+            if self.qos is not None:
+                # Per-tenant MRC slices (TENANT_QOS + OBS_LIFECYCLE):
+                # each tenant's own reuse-distance curve — the "how much
+                # cache does THIS tenant's hit rate actually need" input
+                # for cache_share sizing. Key presence only with the
+                # knob on keeps the legacy payload bit-identical.
+                payload["tenants"] = {
+                    t: debug_mrc_payload(est, tier_capacities=caps)
+                    for t, est in sorted(
+                        dict(self.engine.block_manager._tenant_mrc).items()
+                    )
+                }
+            return web.json_response(payload)
 
         async def debug_flight(request: web.Request) -> web.Response:
             """Flight-recorder counters + the latest triggered timeline
