@@ -171,6 +171,24 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
   BENCH_TENANT_QOS_SPEC=...  policy for the knob-on run (default:
                        premium prio 0 weight 4; batch prio 1 with
                        max_waiting=6 and cache_share=0.3)
+  BENCH_KV_INTEGRITY=1 corruption-drill arm (ISSUE 19): three runs of a
+                       spill-heavy host-tier workload on ONE pod — knob
+                       off (the baseline outputs), KV_INTEGRITY on clean
+                       (the digest-overhead A/B), and KV_INTEGRITY on
+                       with byte flips injected into spilled host pages
+                       mid-run. Every flip must be detected at
+                       restore/export/scrub and quarantined BEFORE any
+                       token is emitted, and the drill's greedy outputs
+                       must match the baseline exactly — the
+                       zero-corrupted-tokens evidence; the makespan
+                       ratios price the digest overhead (clean/off) and
+                       the quarantine+cold-recompute recovery
+                       (drill/clean)
+  BENCH_KV_INTEGRITY_FLIPS=N  byte flips injected by the drill run
+                       (default 4; each lands on a distinct chain)
+  BENCH_KV_INTEGRITY_PAGES=N  HBM pool size for the arm (default ~2
+                       active sequences, so every warm prefix lives on
+                       the spill→restore edge the digests guard)
 """
 
 from __future__ import annotations
@@ -1847,6 +1865,128 @@ def run_tenant_qos_arm(
     return out
 
 
+def run_kv_integrity_arm(
+    workload, params, engine_cfg, max_new_tokens, flips=0, flip_seed=0,
+):
+    """ISSUE 19 corruption-drill arm: ONE pod, requests served
+    SEQUENTIALLY (add → run to completion → next) against a pool sized
+    so every warm prefix spills to the host tier between revisits — the
+    spill→restore edge the write-time digests guard. Sequential on
+    purpose: the trio below is judged on EXACT greedy token parity, and
+    the co-sim's Poisson pacing makes batch composition (hence padding
+    and reduction order, hence near-tie argmaxes) a function of wall
+    time — identical step sequences are what make the parity bar and
+    the makespan A/B sound. ``flips`` > 0 injects single-byte flips
+    into resident host slots at evenly spaced requests (the same fault
+    ``tests/chaos``'s ``corrupt_host_slot`` models: bit rot in the
+    spilled copy, invisible until the page is next restored, exported,
+    or scrubbed); a final full scrub sweeps whatever latent rot the
+    traffic never revisited.
+
+    Returns ``(metrics, outputs)`` — outputs are the per-request greedy
+    token ids, so the caller can assert exact parity across the
+    off / on-clean / on-drill trio: detection + quarantine + cold
+    recompute must serve ZERO corrupted tokens, and the clean knob-on
+    run must be bit-identical to the knob-off baseline."""
+    from collections import deque as _deque
+
+    from llm_d_kv_cache_manager_tpu.server.engine import Engine
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    engine = Engine(engine_cfg, params=params, on_events=lambda _ev: None)
+    frng = np.random.default_rng(flip_seed)
+    flipped: set[int] = set()
+
+    def flip_host_page() -> int:
+        # One byte, one distinct resident chain per injection; quarantined
+        # chains are excluded (their host copy is already destroyed).
+        engine._flush_page_moves()
+        bm = engine.block_manager
+        cands = [
+            h
+            for h in bm._host_cached
+            if h not in flipped
+            and (
+                engine.integrity is None
+                or not engine.integrity.is_quarantined(h)
+            )
+        ]
+        if not cands:
+            return 0
+        h = cands[int(frng.integers(len(cands)))]
+        flat = engine._host_k[bm._host_cached[h]].reshape(-1).view(np.uint8)
+        flat[int(frng.integers(flat.size))] ^= 0xFF
+        flipped.add(h)
+        return 1
+
+    # Same rationale as run_tenant_qos_arm's warm-up: pay this pool
+    # shape's trace/dispatch cost before the timed loop, so the FIRST of
+    # the three runs (the knob-off baseline) isn't charged compile time
+    # the other two never see — that would understate the overhead A/B.
+    warm_len = len(workload[0][2]) if workload else 8
+    wrng = np.random.default_rng(97)
+    warm = wrng.integers(0, engine_cfg.model.vocab_size, warm_len).tolist()
+    for _ in range(2):
+        engine.add_request(warm, SamplingParams(max_new_tokens=max_new_tokens))
+        while engine.has_work:
+            engine.step()
+
+    clock = 0.0
+    samples = _deque(maxlen=64)
+    seqs = []
+    lat = []
+    injected = 0
+
+    def step():
+        nonlocal clock
+        t0 = time.perf_counter()
+        engine.step()
+        dt = time.perf_counter() - t0
+        if STALL_CAP_X and len(samples) >= 20:
+            med = sorted(samples)[len(samples) // 2]
+            dt = min(dt, max(med * STALL_CAP_X, 1.0))
+        samples.append(dt)
+        clock += dt
+
+    cadence = max(len(workload) // (flips + 1), 1) if flips else 0
+    for i, (_t, _seg, tokens) in enumerate(workload):
+        if flips and injected < flips and i and i % cadence == 0:
+            injected += flip_host_page()
+        seq = engine.add_request(
+            tokens, SamplingParams(max_new_tokens=max_new_tokens)
+        )
+        seqs.append(seq)
+        rt0 = clock
+        while engine.has_work:
+            step()
+        lat.append(clock - rt0)
+    if engine.integrity is not None:
+        # Final latent-rot sweep: the scrub path's detection, charged to
+        # the virtual clock like any other engine work.
+        t0 = time.perf_counter()
+        engine.scrub_host_pages(1 << 30)
+        clock += time.perf_counter() - t0
+
+    out = {
+        "p50_request_s": (
+            round(float(np.percentile(lat, 50)), 4) if lat else None
+        ),
+        "p99_request_s": (
+            round(float(np.percentile(lat, 99)), 4) if lat else None
+        ),
+        "makespan_s": round(clock, 4),
+        "injected_flips": injected,
+        "host": dict(engine.block_manager.host_stats),
+        "integrity": (
+            engine.integrity.snapshot() if engine.integrity else None
+        ),
+    }
+    outputs = [list(s.output_tokens) for s in seqs]
+    del engine
+    gc.collect()
+    return out, outputs
+
+
 def run_disagg(
     workload, params, engine_cfg, n_prefill, n_decode, max_new_tokens,
     link_gbps,
@@ -2856,6 +2996,99 @@ def main() -> int:
             ),
         }
 
+    # -- KV integrity arm (ISSUE 19): corruption drill + overhead A/B ----
+    # Three runs of one spill-heavy workload on one pod: knob off (the
+    # baseline greedy outputs), KV_INTEGRITY on clean (what the digests
+    # cost when nothing is wrong — the knob's price tag), and KV_INTEGRITY
+    # on with byte flips injected into spilled host pages (the drill:
+    # every flip detected + quarantined before any token, recovery by
+    # cold recompute to EXACT output parity with the baseline).
+    kv_integrity_detail = None
+    if os.environ.get("BENCH_KV_INTEGRITY", "0") == "1":
+        import dataclasses as _dc
+
+        ki_rng = np.random.default_rng(1907)
+        ki_groups = max(n_groups // 2, 4)
+        ki_wl = build_workload(
+            ki_rng, ki_groups, max(reqs_per_group, 3), prefix_len,
+            suffix_len, model_cfg.vocab_size, [qps_mid] * 3,
+        )
+        prefix_pages = -(-prefix_len // page)
+        seq_pages = -(-(prefix_len + suffix_len + max_new + 1) // page)
+        # Pool holds ~2 active sequences; the host tier holds the whole
+        # prefix working set with slack — every revisit restores from
+        # host, so the verify-on-transition path carries the run.
+        ki_pages = int(
+            os.environ.get(
+                "BENCH_KV_INTEGRITY_PAGES", str(2 * seq_pages + 2)
+            )
+        )
+        ki_host = ki_groups * (prefix_pages + seq_pages) * 2
+        ki_flips = int(os.environ.get("BENCH_KV_INTEGRITY_FLIPS", "4"))
+
+        def ki_cfg(knob):
+            return _dc.replace(
+                engine_cfg,
+                kv_integrity=knob,
+                host_tier_policy="always",
+                block_manager=_dc.replace(
+                    engine_cfg.block_manager,
+                    total_pages=ki_pages,
+                    host_pages=ki_host,
+                ),
+            )
+
+        # Throwaway prelude: a tiny knob-off pass (with one revisit, so
+        # the spill→restore path runs) absorbs the process-level
+        # one-time costs of this pool shape — trace/dispatch of the
+        # cold-prefill, warm-prefill, and restore paths — which would
+        # otherwise land entirely in the FIRST timed run and skew the
+        # overhead A/B.
+        run_kv_integrity_arm(
+            ki_wl[:3] + ki_wl[:1], params, ki_cfg(False), max_new
+        )
+        ki_off, ki_off_out = run_kv_integrity_arm(
+            ki_wl, params, ki_cfg(False), max_new
+        )
+        ki_clean, ki_clean_out = run_kv_integrity_arm(
+            ki_wl, params, ki_cfg(True), max_new
+        )
+        ki_drill, ki_drill_out = run_kv_integrity_arm(
+            ki_wl, params, ki_cfg(True), max_new,
+            flips=ki_flips, flip_seed=1907,
+        )
+        kv_integrity_detail = {
+            "total_pages": ki_pages,
+            "host_pages": ki_host,
+            "n_requests": len(ki_wl),
+            "off": ki_off,
+            "on_clean": ki_clean,
+            "on_drill": ki_drill,
+            # The zero-corrupted-tokens bars: clean knob-on must be
+            # bit-identical to knob-off, and the drill — with every
+            # injected flip detected and recomputed — must be too.
+            "clean_parity_ok": bool(ki_clean_out == ki_off_out),
+            "drill_parity_ok": bool(ki_drill_out == ki_off_out),
+            "overhead_makespan_x": (
+                round(ki_clean["makespan_s"] / ki_off["makespan_s"], 3)
+                if ki_off["makespan_s"]
+                else None
+            ),
+            # Median per-request latency is the sturdier overhead stat at
+            # smoke sizes — makespan is a sum of ~ms steps and CPU jitter
+            # swamps a crc32's worth of signal.
+            "overhead_p50_x": (
+                round(ki_clean["p50_request_s"] / ki_off["p50_request_s"], 3)
+                if ki_off["p50_request_s"]
+                else None
+            ),
+            "drill_over_clean_x": (
+                round(ki_drill["makespan_s"] / ki_clean["makespan_s"], 3)
+                if ki_clean["makespan_s"]
+                else None
+            ),
+        }
+
     # Headline metrics are precise-vs-round_robin by definition: when a
     # BENCH_POLICIES subset omits either, the corresponding fields are
     # null rather than silently reporting another policy's numbers.
@@ -2908,6 +3141,7 @@ def main() -> int:
         "workload_family_spread": family_spreads,
         "fleet_controller": fleet_detail,
         "tenant_qos": tenant_qos_detail,
+        "kv_integrity": kv_integrity_detail,
     }
     print(json.dumps(detail), file=sys.stderr)
 
@@ -3361,6 +3595,42 @@ def main() -> int:
                         ],
                     }
                     if tenant_qos_detail
+                    else None
+                ),
+                # KV-integrity headline (ISSUE 19; null unless the
+                # BENCH_KV_INTEGRITY pass ran): detection completeness
+                # for the injected flips, both parity bars (zero
+                # corrupted tokens), and the two makespan price tags —
+                # the digests when nothing is wrong, the recovery when
+                # something is.
+                "kv_integrity": (
+                    {
+                        "injected_flips": kv_integrity_detail["on_drill"][
+                            "injected_flips"
+                        ],
+                        "detected": kv_integrity_detail["on_drill"][
+                            "integrity"
+                        ]["checks_corrupt"],
+                        "quarantined": kv_integrity_detail["on_drill"][
+                            "integrity"
+                        ]["quarantined"],
+                        "clean_parity_ok": kv_integrity_detail[
+                            "clean_parity_ok"
+                        ],
+                        "drill_parity_ok": kv_integrity_detail[
+                            "drill_parity_ok"
+                        ],
+                        "overhead_makespan_x": kv_integrity_detail[
+                            "overhead_makespan_x"
+                        ],
+                        "overhead_p50_x": kv_integrity_detail[
+                            "overhead_p50_x"
+                        ],
+                        "drill_over_clean_x": kv_integrity_detail[
+                            "drill_over_clean_x"
+                        ],
+                    }
+                    if kv_integrity_detail
                     else None
                 ),
             }

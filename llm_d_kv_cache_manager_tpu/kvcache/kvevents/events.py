@@ -68,6 +68,18 @@ a pod sets ``OBS_AUDIT``, so default traffic stays bit-identical):
   count into the predicted-vs-realized ratio, regret and miss-attribution
   metrics. Observation-only on the index.
 
+KV-integrity extension (ISSUE 19; on the wire only when a pod sets
+``KV_INTEGRITY`` *and* detects a corrupt page, so default traffic stays
+bit-identical):
+
+- ``BadBlock``: ``["BadBlock", block_hashes, pod?, medium?]`` — fleet-wide
+  revocation of a quarantined block: a content-digest check failed, the
+  copy is poison, and every scorer must drop the index entry for the
+  HOLDER pod (``pod``; ``""``, the default, means the publisher itself —
+  an importer that catches a peer's corrupt export names the exporter).
+  ``medium`` narrows the revocation to one tier; None drops every tier.
+  Peers holding replica copies purge them on receipt.
+
 Decoding is positional and tolerant: trailing optional fields may be absent
 (the reference's "legacy" variants, ``events.go:113-153``) and unknown extra
 fields are ignored — this subsumes the reference's arity-sniffing legacy
@@ -89,6 +101,7 @@ INDEX_SNAPSHOT_TAG = "IndexSnapshot"
 POD_DRAINED_TAG = "PodDrained"
 PREFILL_COMPLETE_TAG = "PrefillComplete"
 REQUEST_AUDIT_TAG = "RequestAudit"
+BAD_BLOCK_TAG = "BadBlock"
 
 #: roles a pod may advertise (anything else decodes to None = mixed).
 #: ``kvstore`` (remote tier, ISSUE 13) marks a dedicated KV-store pod:
@@ -222,6 +235,34 @@ class RequestAudit:
         return [REQUEST_AUDIT_TAG, self.request_id, self.realized_blocks]
 
 
+@dataclass
+class BadBlock:
+    """Fleet-wide revocation of quarantined blocks (KV_INTEGRITY): a
+    content-digest check failed, so the named copies are poison. The
+    scorer drops the holder's index entries (every tier unless ``medium``
+    narrows it) and peers purge replica copies. Published under the
+    detector's topic but attributed to the HOLDER identity: ``pod`` names
+    whose bytes are bad (``""`` = the publisher itself — the spelling a
+    pod uses for its own host/HBM tiers; an importer that catches a
+    peer's corrupt export names the exporter). Quarantine marks the bad
+    *copy*, never the token identity — a later ``BlockStored`` for the
+    same hash (fresh recompute) re-registers normally."""
+
+    block_hashes: list[int]
+    #: holder identity ("" = the publishing pod itself)
+    pod: str = ""
+    #: tier of the bad copy ("tpu_hbm"/"host_dram"/"remote"); None = all
+    medium: Optional[str] = None
+
+    def to_tagged_union(self) -> list[Any]:
+        arr: list[Any] = [BAD_BLOCK_TAG, self.block_hashes]
+        if self.pod or self.medium is not None:
+            arr.append(self.pod)
+        if self.medium is not None:
+            arr.append(self.medium)
+        return arr
+
+
 Event = Union[
     BlockStored,
     BlockRemoved,
@@ -231,6 +272,7 @@ Event = Union[
     PodDrained,
     PrefillComplete,
     RequestAudit,
+    BadBlock,
 ]
 
 
@@ -353,6 +395,23 @@ def _decode_event(raw) -> Optional[Event]:
         if not isinstance(n, int) or isinstance(n, bool):
             n = 0
         return RequestAudit(request_id=rid, realized_blocks=n)
+    if tag == BAD_BLOCK_TAG:
+        hashes = _get(fields, 0)
+        if not isinstance(hashes, (list, tuple)):
+            return None
+        pod = _get(fields, 1, "")
+        if isinstance(pod, bytes):
+            pod = pod.decode("utf-8", "replace")
+        if not isinstance(pod, str):
+            pod = ""  # tolerant: a bad holder field means "the publisher"
+        medium = _get(fields, 2)
+        if isinstance(medium, bytes):
+            medium = medium.decode("utf-8", "replace")
+        if medium is not None and not isinstance(medium, str):
+            medium = None  # tolerant: a bad medium widens to every tier
+        return BadBlock(
+            block_hashes=[int(h) for h in hashes], pod=pod, medium=medium
+        )
     return None  # unknown tag
 
 

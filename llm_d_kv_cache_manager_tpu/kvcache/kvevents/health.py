@@ -80,6 +80,9 @@ class _PodState:
     #: remote-tier headroom the pod last advertised (pages its remote
     #: store will still accept); None = never advertised (REMOTE_TIER off)
     headroom: Optional[int] = None
+    #: blocks revoked from this pod by BadBlock events (KV_INTEGRITY) —
+    #: a climbing count is the bad-block-storm signal the runbook keys on
+    bad_blocks: int = 0
 
 
 class FleetHealth:
@@ -104,6 +107,8 @@ class FleetHealth:
         self.publisher_drops_reported = 0  # guarded_by: _mu
         self.pods_drained = 0  # guarded_by: _mu
         self.prefills_completed = 0  # guarded_by: _mu
+        #: total blocks revoked by BadBlock events (KV_INTEGRITY)
+        self.bad_blocks_reported = 0  # guarded_by: _mu
         #: fleet-controller membership changes (observe_pod_added/_removed)
         self.pods_added = 0  # guarded_by: _mu
         self.pods_removed = 0  # guarded_by: _mu
@@ -268,6 +273,18 @@ class FleetHealth:
             st.last_seen = self._clock()
             st.draining = True
             self.pods_removed += 1
+
+    def observe_bad_block(self, pod: str, count: int = 1) -> None:
+        """A ``BadBlock`` revocation named ``pod`` as the holder of
+        ``count`` corrupt copies (KV_INTEGRITY). Pure observation — the
+        ingestion pool already evicted the index entries; this keeps the
+        per-pod tally the bad-block-storm runbook reads. Deliberately does
+        NOT touch liveness: the event proves the DETECTOR is alive, not
+        the holder."""
+        with self._mu:
+            st = self._pods.setdefault(pod, _PodState())
+            st.bad_blocks += count
+            self.bad_blocks_reported += count
 
     def observe_prefill_complete(self, pod: str) -> None:
         """A ``PrefillComplete`` event: a prefill-role pod finished a
@@ -495,6 +512,13 @@ class FleetHealth:
                         if st.headroom is not None
                         else {}
                     ),
+                    # Key only for pods with revoked blocks: knob-less
+                    # fleets keep bit-identical snapshot payloads.
+                    **(
+                        {"bad_blocks": st.bad_blocks}
+                        if st.bad_blocks
+                        else {}
+                    ),
                 }
                 for pod, st in self._pods.items()
             }
@@ -514,6 +538,12 @@ class FleetHealth:
                 **(
                     {"prefills_completed": self.prefills_completed}
                     if self.prefills_completed
+                    else {}
+                ),
+                # Same rule: key appears only once a BadBlock landed.
+                **(
+                    {"bad_blocks_reported": self.bad_blocks_reported}
+                    if self.bad_blocks_reported
                     else {}
                 ),
                 # Same rule: keys appear only once a fleet controller has

@@ -22,6 +22,7 @@ from ...utils import RateLimitedWarn, get_logger
 from ..kvblock import DeviceTier, Index, Key, PodEntry, tier_for_medium
 from .events import (
     AllBlocksCleared,
+    BadBlock,
     BlockRemoved,
     BlockStored,
     Heartbeat,
@@ -84,8 +85,12 @@ class KVEventsPool:
     ``lifecycle`` (optional, an ``obs.lifecycle.BlockLifecycleLedger``)
     receives the per-pod ``BlockStored``/``BlockRemoved`` tier story —
     the scorer-side half of the OBS_LIFECYCLE ledger, derived from the
-    stream this pool already decodes (no new wire fields).
-    All ``None`` (default) keeps the legacy behavior bit-identical.
+    stream this pool already decodes (no new wire fields);
+    ``on_bad_block`` (optional, ``fn(holder, block_hashes, medium)``)
+    fires after a ``BadBlock`` revocation lands on the index — serving
+    layers hook replica purges (remote-store copies of the revoked
+    block) here. All ``None`` (default) keeps the legacy behavior
+    bit-identical.
     """
 
     def __init__(
@@ -97,6 +102,7 @@ class KVEventsPool:
         staleness=None,
         audit=None,
         lifecycle=None,
+        on_bad_block=None,
     ):
         self.config = config or KVEventsPoolConfig()
         if self.config.concurrency < 1:
@@ -106,6 +112,7 @@ class KVEventsPool:
         self.staleness = staleness
         self.audit = audit
         self.lifecycle = lifecycle
+        self.on_bad_block = on_bad_block
         self._mu = threading.Lock()
         #: tasks rejected because the pool was already shut down — after the
         #: poison pill a task would sit unprocessed forever, which is worse
@@ -260,6 +267,49 @@ class KVEventsPool:
                     self.lifecycle.observe_removed(
                         msg.pod_identifier, ev.block_hashes, ev.medium
                     )
+            elif isinstance(ev, BadBlock):
+                # Fleet-wide revocation: a pod's digest check caught a
+                # corrupt copy. The HOLDER (``ev.pod`` when the detector
+                # published under its own identity on a peer's behalf,
+                # else the publisher itself) loses its index entry NOW —
+                # the scorer must stop routing toward poisoned warmth —
+                # and replica purges fan out via ``on_bad_block``.
+                holder = ev.pod or msg.pod_identifier
+                if ev.medium is None:
+                    entries = [PodEntry(holder, t) for t in DeviceTier]
+                else:
+                    entries = [PodEntry(holder, tier_for_medium(ev.medium))]
+                for h in ev.block_hashes:
+                    try:
+                        self.index.evict(Key(msg.model_name, h), entries)
+                    except Exception:
+                        _warn.warning(
+                            "bad-block-evict",
+                            "failed to revoke bad block from index",
+                            exc_info=True,
+                            pod=holder,
+                        )
+                if self.audit is not None:
+                    # Routes already in flight toward the revoked entry
+                    # will miss: attribute those as ``quarantined``.
+                    self.audit.observe_bad_block(ev.block_hashes)
+                if self.health is not None:
+                    self.health.observe_bad_block(
+                        holder, len(ev.block_hashes)
+                    )
+                from ..metrics import collector
+
+                collector.observe_bad_blocks(len(ev.block_hashes))
+                if self.on_bad_block is not None:
+                    try:
+                        self.on_bad_block(holder, ev.block_hashes, ev.medium)
+                    except Exception:
+                        _warn.warning(
+                            "bad-block-purge",
+                            "bad-block purge callback failed",
+                            exc_info=True,
+                            pod=holder,
+                        )
             elif isinstance(ev, Heartbeat):
                 if self.health is not None:
                     self.health.observe_heartbeat(

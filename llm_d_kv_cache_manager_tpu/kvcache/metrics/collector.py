@@ -108,6 +108,14 @@ shard_misroutes = _NullMetric()
 block_transitions = _NullMetric()
 block_residency = _NullMetric()
 reuse_distance = _NullMetric()
+# KV-block integrity plane (ISSUE 19): content-digest checks at tier
+# transitions, quarantines, scrubber coverage, and fleet BadBlock
+# revocations. Series appear only when KV_INTEGRITY feeds them — a
+# knobs-off process never touches a label.
+integrity_checks = _NullMetric()
+integrity_quarantined = _NullMetric()
+integrity_scrub_pages = _NullMetric()
+integrity_bad_blocks = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -148,6 +156,8 @@ def register(registry=None) -> None:
     global route_predicted_ttft, route_ttft_ratio
     global shard_blocks, shard_pods, shard_misroutes
     global block_transitions, block_residency, reuse_distance
+    global integrity_checks, integrity_quarantined
+    global integrity_scrub_pages, integrity_bad_blocks
     with _lock:
         if _registered:
             return
@@ -297,7 +307,7 @@ def register(registry=None) -> None:
             "kvcache_route_miss_attributed_total",
             "Audited requests whose realized hits fell short of the "
             "prediction, by attributed cause (stale_index / evicted_on_pod "
-            "/ never_stored / dead_pod_reroute; OBS_AUDIT)",
+            "/ never_stored / dead_pod_reroute / quarantined; OBS_AUDIT)",
             ["cause"],
             registry=registry,
         )
@@ -370,6 +380,36 @@ def register(registry=None) -> None:
             registry=registry,
             buckets=tuple(float(b) for b in REUSE_DISTANCE_BUCKETS),
         )
+        integrity_checks = _prom.Counter(
+            "kvcache_integrity_checks_total",
+            "Content-digest verifications at KV tier transitions "
+            "(KV_INTEGRITY), by transition path (restore / prefetch / "
+            "import / remote_accept / remote_serve / export / scrub) and "
+            "outcome (ok / corrupt / unverified — no recorded digest)",
+            ["path", "outcome"],
+            registry=registry,
+        )
+        integrity_quarantined = _prom.Counter(
+            "kvcache_integrity_quarantined_total",
+            "KV block copies quarantined after a failed content-digest "
+            "check (KV_INTEGRITY), by the tier holding the bad copy "
+            "(host_dram / remote / wire)",
+            ["tier"],
+            registry=registry,
+        )
+        integrity_scrub_pages = _prom.Counter(
+            "kvcache_integrity_scrub_pages_total",
+            "Resident host-tier pages verified by the background "
+            "integrity scrubber (KV_INTEGRITY + INTEGRITY_SCRUB_INTERVAL_S)",
+            registry=registry,
+        )
+        integrity_bad_blocks = _prom.Counter(
+            "kvcache_integrity_bad_blocks_total",
+            "Block hashes revoked fleet-wide by BadBlock events as seen "
+            "by this process (published locally or applied by the scorer "
+            "index; KV_INTEGRITY)",
+            registry=registry,
+        )
         _registered = True
 
 
@@ -433,6 +473,32 @@ def observe_ttft_ratio(ratio: float) -> None:
 def observe_miss_cause(cause: str) -> None:
     bump(f"route_miss_{cause}")
     route_miss.labels(cause=cause).inc()
+
+
+def observe_integrity_check(path: str, outcome: str) -> None:
+    """One content-digest verification at a tier transition (KV_INTEGRITY)."""
+    bump(f"integrity_checks_{outcome}")
+    integrity_checks.labels(path=path, outcome=outcome).inc()
+
+
+def observe_quarantine(tier: str) -> None:
+    """One block copy quarantined after a corrupt digest (KV_INTEGRITY)."""
+    bump("integrity_quarantined")
+    integrity_quarantined.labels(tier=tier).inc()
+
+
+def observe_scrub_pages(n: int) -> None:
+    """Host-tier pages the background scrubber verified (KV_INTEGRITY)."""
+    if n:
+        bump("integrity_scrub_pages", n)
+        integrity_scrub_pages.inc(n)
+
+
+def observe_bad_blocks(n: int) -> None:
+    """Block hashes revoked by BadBlock events (KV_INTEGRITY)."""
+    if n:
+        bump("integrity_bad_blocks", n)
+        integrity_bad_blocks.inc(n)
 
 
 def observe_tier_transition(frm: str, to: str, reason: str) -> None:

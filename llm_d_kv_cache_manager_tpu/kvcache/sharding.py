@@ -48,6 +48,7 @@ from ..utils import RateLimitedWarn, get_logger
 from .kvblock import DeviceTier, Index, Key, PodEntry, tier_for_medium
 from .kvevents.events import (
     AllBlocksCleared,
+    BadBlock,
     BlockRemoved,
     BlockStored,
     Heartbeat,
@@ -481,18 +482,21 @@ class ShardedEventsPool:
         staleness: Optional[Sequence] = None,
         audit=None,
         lifecycle=None,
+        on_bad_block=None,
         instrument: bool = False,
     ):
         """``instrument=True`` keeps the admission/eviction counters in
         step with the single plane, where the pool applies through the
         ``InstrumentedIndex`` decorator: here the shard workers write to
-        the raw sub-indexes, so the plane accounts its own applies."""
+        the raw sub-indexes, so the plane accounts its own applies.
+        ``on_bad_block``: same replica-purge hook as the single pool's."""
         self.config = config or ShardedEventsPoolConfig()
         if self.config.dispatchers < 1:
             raise ValueError("dispatchers must be >= 1")
         self.index = index
         self.health = health
         self.audit = audit
+        self.on_bad_block = on_bad_block
         #: OBS_LIFECYCLE ledger (obs/lifecycle.py): fed at the decode
         #: stage (per-pod dispatcher order, same vantage as health), so
         #: the sharded plane's block tier story matches the single pool's.
@@ -706,6 +710,41 @@ class ShardedEventsPool:
                     self.lifecycle.observe_removed(
                         msg.pod_identifier, ev.block_hashes, ev.medium
                     )
+            elif isinstance(ev, BadBlock):
+                # Fleet revocation, split by range like BlockRemoved —
+                # point evictions on each hash's owner shard, keyed to
+                # the HOLDER (``ev.pod`` when the detector revoked a
+                # peer's copy, else the publisher).
+                flush_adds()
+                holder = ev.pod or msg.pod_identifier
+                if ev.medium is None:
+                    entries = [PodEntry(holder, t) for t in DeviceTier]
+                else:
+                    entries = [PodEntry(holder, tier_for_medium(ev.medium))]
+                touched: set[int] = set()
+                for h in ev.block_hashes:
+                    shard = ring.owner(h)
+                    task_for(shard).ops.append(("evict", h, entries))
+                    touched.add(shard)
+                for shard in touched:
+                    tasks[shard].tags.append("BadBlock")
+                if self.audit is not None:
+                    self.audit.observe_bad_block(ev.block_hashes)
+                if self.health is not None:
+                    self.health.observe_bad_block(
+                        holder, len(ev.block_hashes)
+                    )
+                collector.observe_bad_blocks(len(ev.block_hashes))
+                if self.on_bad_block is not None:
+                    try:
+                        self.on_bad_block(holder, ev.block_hashes, ev.medium)
+                    except Exception:
+                        _warn.warning(
+                            "bad-block-purge",
+                            "bad-block purge callback failed",
+                            exc_info=True,
+                            pod=holder,
+                        )
             elif isinstance(ev, Heartbeat):
                 if self.health is not None:
                     self.health.observe_heartbeat(

@@ -15,7 +15,10 @@ raising — a poison request must never kill the export service).
   compression — ``quant`` names the scheme, the scales are raw f32
   bytes of ``models/quant.kv_scale_shape``; appended ONLY when the
   exporter quantizes, so legacy wire bytes are unchanged and old
-  importers, positional and tolerant, simply ignore it)
+  importers, positional and tolerant, simply ignore it; a further
+  optional trailing ``digest?`` — the KV_INTEGRITY write-time content
+  checksum — rides after the triple, absent-triple positions filled
+  with their decode defaults)
 - error: ``["TransferError", message]``
 
 Remote-tier demotion extension (``REMOTE_TIER``; never on the wire unless
@@ -90,6 +93,11 @@ class BlockPayload:
     quant: Optional[str] = None
     k_scale: bytes = b""
     v_scale: bytes = b""
+    #: write-time content digest (``kvcache/integrity.page_digest`` over
+    #: the payload bytes, KV_INTEGRITY) — None = sender does not attest.
+    #: Rides as an optional trailing field, so knobs-off wire bytes are
+    #: bit-identical and old importers simply ignore it.
+    digest: Optional[int] = None
 
     @property
     def wire_bytes(self) -> int:
@@ -172,6 +180,13 @@ def encode_block_row(b: BlockPayload) -> list:
         # Trailing optional triple: only on the wire for quantized
         # blocks, so unquantized response bytes stay bit-identical.
         raw.extend([b.quant, b.k_scale, b.v_scale])
+    if b.digest is not None:
+        if b.quant is None:
+            # The digest rides at a fixed position past the quant triple;
+            # fill the absent triple with its decode defaults (None
+            # scheme + empty scales read exactly like no triple at all).
+            raw.extend([None, b"", b""])
+        raw.append(b.digest)
     return raw
 
 
@@ -226,6 +241,15 @@ def _decode_block(raw: Any) -> Optional[BlockPayload]:
         v_scale, (bytes, bytearray)
     ):
         return None
+    # Optional trailing content digest (KV_INTEGRITY): absent on legacy
+    # frames; a malformed digest decodes to None (unattested) — tolerant,
+    # the importer falls back to the legacy trust model, never a crash.
+    digest = raw[11] if len(raw) > 11 else None
+    if digest is not None:
+        try:
+            digest = int(digest)
+        except (TypeError, ValueError):
+            digest = None
     try:
         return BlockPayload(
             block_hash=int(h),
@@ -239,6 +263,7 @@ def _decode_block(raw: Any) -> Optional[BlockPayload]:
             quant=quant,
             k_scale=bytes(k_scale),
             v_scale=bytes(v_scale),
+            digest=digest,
         )
     except (TypeError, ValueError):
         return None

@@ -21,8 +21,14 @@ Validation mirrors the import path's trust model: geometry (page size,
 logical shape, dtype, payload byte lengths — including the int8 scale
 triple's exact size) and the chain-hash self-consistency check
 (``hash_block(parent, token_ids) == block_hash``), so a tampered or
-truncated push registers nothing. KV bytes themselves are necessarily
-trusted — verifying them would be the recompute demotion exists to avoid.
+truncated push registers nothing. The KV bytes themselves are covered by
+the payload's carried content digest when the KV_INTEGRITY plane is
+attached: a push whose bytes fail their own digest is rejected, and a
+stored block that rots is caught at serve time — quarantined, removed,
+and revoked fleet-wide via ``BadBlock`` — before any importer installs
+it. Unattested payloads (legacy senders) keep the legacy trust model:
+verifying without a digest would be the recompute demotion exists to
+avoid.
 """
 
 from __future__ import annotations
@@ -31,11 +37,12 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
-from ...utils import get_logger
+from ...utils import RateLimitedWarn, get_logger
 from ..kvblock.token_processor import hash_block
 from .protocol import BlockPayload
 
 log = get_logger("kvcache.transfer.remote_store")
+_warn = RateLimitedWarn(log)
 
 
 @dataclass
@@ -67,11 +74,19 @@ class RemoteBlockStore:
         self,
         config: RemoteStoreConfig,
         on_events: Optional[Callable[[list], None]] = None,
+        integrity=None,
     ):
         if config.capacity_pages < 0:
             raise ValueError("capacity_pages must be >= 0")
         self.config = config
         self.on_events = on_events
+        #: KV_INTEGRITY plane (a ``BlockIntegrity``), or None = legacy
+        #: trust model. The store never uses the side TABLE — a stored
+        #: payload carries its own digest (``BlockPayload.digest``), so a
+        #: block that is simultaneously host-resident here under a
+        #: different representation cannot collide; the instance only
+        #: feeds the shared check/quarantine accounting.
+        self.integrity = integrity
         self._blocks: "OrderedDict[int, BlockPayload]" = OrderedDict()
         import numpy as np
 
@@ -86,6 +101,11 @@ class RemoteBlockStore:
             "evicted": 0,
             "served": 0,
         }
+        if integrity is not None:
+            # Extra keys only when the knob is on: the knobs-off /stats
+            # payload (which embeds this dict) stays bit-identical.
+            self.stats["digest_rejected"] = 0
+            self.stats["quarantined"] = 0
 
     def __len__(self) -> int:
         return len(self._blocks)
@@ -136,13 +156,19 @@ class RemoteBlockStore:
         )
         return hash_block(parent, blk.token_ids) == blk.block_hash
 
-    def accept(self, blocks: Sequence[BlockPayload]) -> int:
+    def accept(
+        self, blocks: Sequence[BlockPayload], source_pod: str = ""
+    ) -> int:
         """Commit pushed blocks; returns how many registered. Invalid
         blocks are rejected individually (unlike the import path there is
         no chain-continuity requirement — a store may hold mid-chain runs
         whose parents live elsewhere in the fleet; the pull-back walk is
         what enforces consecutiveness). Over capacity the LRU block is
-        dropped, with its ``BlockRemoved(remote)`` goodbye."""
+        dropped, with its ``BlockRemoved(remote)`` goodbye.
+
+        ``source_pod`` (the pusher) contextualizes reject warnings; a
+        storm of rejects from one peer logs rate-limited, never one line
+        per block."""
         if self.config.capacity_pages == 0:
             return 0
         from ..kvevents.events import BlockRemoved, BlockStored
@@ -155,7 +181,38 @@ class RemoteBlockStore:
                 continue
             if not self._valid(blk):
                 self.stats["rejected"] += 1
+                _warn.warning(
+                    "accept-reject",
+                    "pushed KV block rejected (geometry/chain-hash)",
+                    pod=source_pod or "<unknown>",
+                    block=blk.block_hash,
+                )
                 continue
+            if self.integrity is not None:
+                from ..integrity import CHECK_CORRUPT, page_digest
+
+                computed = page_digest(
+                    blk.k_data, blk.v_data, blk.k_scale, blk.v_scale
+                )
+                if (
+                    self.integrity.check_carried(
+                        blk.block_hash, blk.digest, computed, "remote_accept"
+                    )
+                    == CHECK_CORRUPT
+                ):
+                    # Bytes rotted in flight: refuse to register — the
+                    # block never becomes servable, so no BadBlock (there
+                    # is no index entry to revoke, and the pusher's local
+                    # copy is already gone either way).
+                    self.stats["rejected"] += 1
+                    self.stats["digest_rejected"] += 1
+                    _warn.warning(
+                        "accept-digest",
+                        "pushed KV block failed content digest; rejected",
+                        pod=source_pod or "<unknown>",
+                        block=blk.block_hash,
+                    )
+                    continue
             while len(self._blocks) >= self.config.capacity_pages:
                 old_h, _ = self._blocks.popitem(last=False)
                 self.stats["evicted"] += 1
@@ -191,8 +248,64 @@ class RemoteBlockStore:
             blk = self._blocks.get(h)
             if blk is None:
                 break
+            if self.integrity is not None and blk.digest is not None:
+                from ..integrity import CHECK_CORRUPT, page_digest
+
+                computed = page_digest(
+                    blk.k_data, blk.v_data, blk.k_scale, blk.v_scale
+                )
+                if (
+                    self.integrity.check_carried(
+                        h, blk.digest, computed, "remote_serve"
+                    )
+                    == CHECK_CORRUPT
+                ):
+                    # The stored copy rotted under us: destroy it before
+                    # any importer installs it, revoke this holder's
+                    # index entry, and tell the fleet. The served run
+                    # breaks here regardless — consecutiveness is the
+                    # contract.
+                    del self._blocks[h]
+                    self.stats["quarantined"] += 1
+                    self.integrity.quarantine(h, tier="remote")
+                    if self.on_events is not None:
+                        from ..kvevents.events import BadBlock, BlockRemoved
+
+                        self.on_events(
+                            [
+                                BlockRemoved(block_hashes=[h], medium="remote"),
+                                BadBlock(block_hashes=[h], medium="remote"),
+                            ]
+                        )
+                    log.warning(
+                        "stored KV block failed digest check; quarantined",
+                        block=h,
+                    )
+                    break
             self._blocks.move_to_end(h)
             out.append(blk)
         if out:
             self.stats["served"] += len(out)
         return out
+
+    def purge(self, hashes: Sequence[int]) -> int:
+        """Fleet revocation consumer: drop every listed block this store
+        still holds (a peer published ``BadBlock`` for them). Emits the
+        holder's own ``BlockRemoved(remote)`` goodbyes so the index
+        forgets this replica too. Input-driven, not knob-gated — a legacy
+        pod must also honor a revocation it receives. Returns blocks
+        dropped."""
+        dropped = [h for h in hashes if self._blocks.pop(h, None) is not None]
+        if not dropped:
+            return 0
+        # Lazy key: appears only once a revocation actually lands, so a
+        # legacy pod that never sees one keeps its exact /stats payload.
+        self.stats["purged"] = self.stats.get("purged", 0) + len(dropped)
+        if self.on_events is not None:
+            from ..kvevents.events import BlockRemoved
+
+            self.on_events([BlockRemoved(block_hashes=dropped, medium="remote")])
+        log.warning(
+            "purged revoked KV blocks from remote store", blocks=len(dropped)
+        )
+        return len(dropped)

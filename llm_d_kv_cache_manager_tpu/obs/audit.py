@@ -32,7 +32,13 @@ behavior when unattached:
     the blocks were evicted after scoring and the prediction aged out;
   * ``evicted_on_pod``  — the index still claims the blocks but the pod's
     ground truth disagrees: the pod evicted them locally and the index
-    has not caught up (phantom locality, repaired by events/resync).
+    has not caught up (phantom locality, repaired by events/resync);
+  * ``quarantined``     — (KV_INTEGRITY, ISSUE 19) a block in the scored
+    chain was revoked by a ``BadBlock`` event since the decision: the
+    miss is the integrity plane doing its job (the pod refused to serve
+    a corrupt page and recomputed), not index staleness — attributing it
+    as ``evicted_on_pod`` would send an operator chasing phantom
+    locality during a bad-block storm.
 
 Since ISSUE 14 the join also carries the predicted-TTFT loop: decisions
 made by the ROUTE_PREDICT latency model record their modeled TTFT, joins
@@ -73,6 +79,7 @@ MISS_CAUSES = (
     "evicted_on_pod",
     "never_stored",
     "dead_pod_reroute",
+    "quarantined",
 )
 
 
@@ -406,6 +413,11 @@ class RouteAuditor:
         self.unmatched_realized = 0  # guarded_by: _mu
         self.pending_evicted = 0  # guarded_by: _mu
         self.miss_causes = dict.fromkeys(MISS_CAUSES, 0)  # guarded_by: _mu
+        #: recently revoked block hashes (BadBlock events; bounded — the
+        #: attribution window only needs "was this chain hit by a recent
+        #: revocation", not a durable ledger)
+        self._bad_blocks: "OrderedDict[int, None]" = OrderedDict()  # guarded_by: _mu
+        self._bad_blocks_cap = 4096
 
     # -- decision side (router/scorer) ---------------------------------------
     def record_decision(
@@ -529,14 +541,34 @@ class RouteAuditor:
             self._ring.append(audit)
         return audit
 
+    def observe_bad_block(self, block_hashes: Sequence[int]) -> None:
+        """A ``BadBlock`` revocation reached the scorer: remember the
+        hashes (bounded FIFO) so a subsequent realized-miss on a chain
+        containing one attributes as ``quarantined`` rather than
+        ``evicted_on_pod`` — the eviction was deliberate poison control,
+        not index rot."""
+        with self._mu:
+            for h in block_hashes:
+                self._bad_blocks[int(h)] = None
+            while len(self._bad_blocks) > self._bad_blocks_cap:
+                self._bad_blocks.popitem(last=False)
+
     def _attribute(self, rec: _Pending, realized_pod: str) -> str:
         """Classify one miss using current index + fleet-health state (see
-        the module docstring for the four causes)."""
+        the module docstring for the causes)."""
         fh = self.fleet_health
         if realized_pod != rec.chosen_pod or (
             fh is not None and not fh.is_routable(rec.chosen_pod)
         ):
             return "dead_pod_reroute"
+        if rec.chain_hashes:
+            with self._mu:
+                if any(h in self._bad_blocks for h in rec.chain_hashes):
+                    # A revocation hit the scored chain after the decision:
+                    # the pod quarantined a corrupt copy and recomputed —
+                    # checked before the index probes because the BadBlock
+                    # eviction makes those read as stale/evicted too.
+                    return "quarantined"
         if rec.index_blocks <= 0:
             # The index never claimed the chain on this pod — the
             # prediction came from affinity memory (or a wiped index).

@@ -253,8 +253,22 @@ class ScoringService:
                 collector.start_metrics_logging(cfg.metrics_logging_interval)
         return index
 
-    def __init__(self, config: Optional[ServiceConfig] = None, *, tokenizer=None):
+    def __init__(
+        self,
+        config: Optional[ServiceConfig] = None,
+        *,
+        tokenizer=None,
+        on_bad_block=None,
+    ):
+        """``on_bad_block`` (optional, ``fn(holder, block_hashes,
+        medium)``): the fleet-revocation purge hook, threaded into the
+        events pool. In-process fleet harnesses wire it to every pod's
+        ``purge_bad_blocks`` so a ``BadBlock`` from one holder also
+        destroys replica copies peers still store; a networked deployment
+        leaves it None (index revocation here is what unroutes the bytes,
+        and each holder quarantines its own copy at verify time)."""
         self.config = config or ServiceConfig()
+        self._on_bad_block = on_bad_block
         cfg = self.config
 
         # Fleet health is always attached (observation is free); expiry +
@@ -377,6 +391,7 @@ class ScoringService:
                 audit=self.route_auditor,
                 lifecycle=self.lifecycle,
                 instrument=cfg.enable_metrics,
+                on_bad_block=self._on_bad_block,
             )
             if isinstance(self.staleness, MergedStaleness):
                 # Fold the plane's admission-edge backlog (batches queued
@@ -391,6 +406,7 @@ class ScoringService:
                 staleness=self.staleness,
                 audit=self.route_auditor,
                 lifecycle=self.lifecycle,
+                on_bad_block=self._on_bad_block,
             )
         self.subscriber = ZMQSubscriber(
             self.events_pool,

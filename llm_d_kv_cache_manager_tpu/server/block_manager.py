@@ -29,12 +29,13 @@ codes + scales between tiers; see ``Engine._flush_page_moves``).
 
 from __future__ import annotations
 
+import bisect
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence as Seq
 
 from ..kvcache.kvblock import ChunkedTokenDatabase, TokenProcessorConfig
-from ..kvcache.kvevents.events import BlockRemoved, BlockStored, Event
+from ..kvcache.kvevents.events import BadBlock, BlockRemoved, BlockStored, Event
 from ..utils import get_logger
 from .sequence import Sequence
 
@@ -129,6 +130,15 @@ class BlockManager:
         #: per-tenant first-prefill hit accounting (requests /
         #: prompt_tokens / cached_tokens / capped_evictions), for /stats.
         self.tenant_stats: dict[str, dict[str, int]] = {}
+        #: KV_INTEGRITY plane (attach_integrity; both None = knob off,
+        #: every path below is bit-identical legacy). ``_integrity`` is
+        #: the digest side table, ``_host_verify(slot, h, reason)`` the
+        #: engine's host-slot digest check.
+        self._integrity = None
+        self._host_verify = None
+        #: rotating scrub position (last host slot verified by the
+        #: background scrubber; engine-thread-only like the pools)
+        self._scrub_cursor = -1
         self._host_free: list[int] = list(range(config.host_pages - 1, -1, -1))
         self._host_cached: dict[int, int] = {}  # chain_hash -> host slot
         self._host_info: dict[int, _PageInfo] = {}  # host slot -> metadata
@@ -188,6 +198,81 @@ class BlockManager:
         reuse-distance estimator for /debug/mrc."""
         self._qos = qos
         self._tenant_mrc_factory = mrc_factory
+
+    def attach_integrity(self, integrity, host_verify) -> None:
+        """Attach the ``KV_INTEGRITY`` plane (``kvcache/integrity.py``):
+        ``integrity`` is the content-digest side table; ``host_verify(slot,
+        h, reason) -> bool`` is the engine's check — it recomputes the
+        digest over the host-tier arrays for ``slot``, records the outcome
+        (``reason`` maps to the metric's path label), quarantines on
+        mismatch, and returns False only for a CORRUPT copy (unverified
+        passes — absence of evidence never truncates a chain). On a False
+        return this class runs the recovery choreography: free the slot,
+        emit ``BlockRemoved`` + ``BadBlock``, and let the caller's chain
+        walk break — cold recompute IS the recovery. Unattached (the
+        default) no path here changes."""
+        self._integrity = integrity
+        self._host_verify = host_verify
+
+    def _quarantine_host_slot(self, slot: int, info: _PageInfo) -> None:
+        """Destroy a host-tier copy that failed its digest check (the
+        caller already removed the slot from cached/info/lru maps — or is
+        about to; this finishes the choreography): the slot returns to the
+        free list, the ledger records the quarantine, and the fleet learns
+        via ``BlockRemoved`` (index entry) + ``BadBlock`` (revocation +
+        replica purge). Deliberately NOT counted as ``host_evicted`` —
+        that stat means capacity pressure, and a corruption storm must not
+        masquerade as one."""
+        h = info.chain_hash
+        self._host_free.append(slot)
+        self._record_lifecycle(h, "none", "quarantine", tenant=info.tenant)
+        self._emit(BlockRemoved(block_hashes=[h], medium="host_dram"))
+        self._emit(BadBlock(block_hashes=[h], medium="host_dram"))
+        log.warning(
+            "host KV copy failed digest check; quarantined",
+            block=h,
+            slot=slot,
+        )
+
+    def quarantine_host_block(self, h) -> bool:
+        """Remove block ``h``'s host-tier copy through the quarantine
+        choreography (engine loop only). Returns True when a copy was
+        resident and has been destroyed; False when the host tier holds
+        no copy (nothing to do)."""
+        slot = self._host_cached.pop(h, None)
+        if slot is None:
+            return False
+        info = self._host_info.pop(slot)
+        self._host_lru.pop(slot, None)
+        self._quarantine_host_slot(slot, info)
+        return True
+
+    def scrub_host_tier(self, max_pages: int) -> int:
+        """Background integrity scrub: verify up to ``max_pages`` resident
+        host-tier slots against their write-time digests, rotating through
+        the tier across calls so every slot is eventually covered. Corrupt
+        copies get the full quarantine choreography (slot freed,
+        ``BlockRemoved`` + ``BadBlock`` emitted). Returns slots checked.
+        Caller must be the engine loop (page-pool ownership rule)."""
+        if self._host_verify is None or max_pages <= 0:
+            return 0
+        slots = sorted(self._host_info)
+        if not slots:
+            return 0
+        start = bisect.bisect_right(slots, self._scrub_cursor)
+        order = slots[start:] + slots[:start]
+        checked = 0
+        for slot in order[: max(max_pages, 0)]:
+            info = self._host_info.get(slot)
+            if info is None:
+                continue
+            self._scrub_cursor = slot
+            checked += 1
+            if not self._host_verify(slot, info.chain_hash, "scrub"):
+                self.quarantine_host_block(info.chain_hash)
+        if checked and self._integrity is not None:
+            self._integrity.note_scrubbed(checked)
+        return checked
 
     def _record_lifecycle(
         self, chain_hash, tier: str, reason: str, tenant: str = ""
@@ -252,6 +337,12 @@ class BlockManager:
                 info.chain_hash, "remote", "demote", tenant=info.tenant
             )
         else:
+            if self._integrity is not None:
+                # Plain capacity eviction destroys the stored bytes the
+                # digest described; the demote path instead hands the
+                # entry's fate to the engine's payload build (which
+                # verifies against it before shipping).
+                self._integrity.drop(info.chain_hash)
             self._record_lifecycle(
                 info.chain_hash, "none", "evict", tenant=info.tenant
             )
@@ -396,6 +487,16 @@ class BlockManager:
         del self._host_cached[h]
         info = self._host_info.pop(slot)
         self._host_lru.pop(slot, None)
+        if self._host_verify is not None and not self._host_verify(
+            slot, h, reason
+        ):
+            # Corrupt host copy caught BEFORE any byte reaches HBM: the
+            # chain walk breaks here (the caller sees a plain miss) and
+            # the suffix recomputes cold — greedy decode stays
+            # token-identical because the recompute writes fresh correct
+            # pages under the same hashes.
+            self._quarantine_host_slot(slot, info)
+            return None
         try:
             page = self._pop_free_page()
         except AllocationError:
@@ -408,6 +509,10 @@ class BlockManager:
             return None
         self._copy_in(slot, page)
         self._host_free.append(slot)
+        if self._integrity is not None:
+            # The digest described the host-slot representation, which is
+            # gone (HBM is trusted); a later re-spill re-records.
+            self._integrity.drop(h)
         self.host_stats["restored"] += 1
         info.ref_count = 0
         self._pages[page] = info

@@ -210,6 +210,20 @@ class EngineConfig:
     #: sets this large and serves nothing else). Gated behind
     #: ``remote_tier``; sizing guidance in docs/operations.md.
     remote_store_pages: int = 0
+    #: KV-block content integrity (ISSUE 19, ``KV_INTEGRITY``): write-time
+    #: per-page digests over stored/wire bytes (kvcache/integrity), verified
+    #: at every tier transition (host restore/prefetch bring-back, remote
+    #: pull-back, transfer import, migration install) before a page becomes
+    #: servable; a failed check quarantines the copy, truncates the chain at
+    #: the bad suffix (cold prefill recomputes it), and publishes a
+    #: ``BadBlock`` revocation. Off by default = bit-identical legacy
+    #: behavior, /stats keys, and wire bytes.
+    kv_integrity: bool = False
+    #: digest side-table capacity in entries (LRU-bounded; a dropped entry
+    #: just means that block restores unverified on the legacy trust
+    #: model). Sized to cover the host tier + remote store several times
+    #: over at 12 bytes/entry; only read when ``kv_integrity`` is on.
+    kv_integrity_table_cap: int = 65536
     #: weight quantization: None (serve in model dtype) or "int8"
     #: (symmetric per-output-channel weight-only int8 — halves weight HBM
     #: bytes so 8B-class models fit one v5e chip with a KV pool;
@@ -494,6 +508,18 @@ class Engine:
         self._pending_restores: list = []
         self._off_by_slot: dict = {}
         self._restore_by_page: dict = {}
+        # -- KV-block content integrity (KV_INTEGRITY; off = None, every
+        # path below is bit-identical legacy) ------------------------------
+        self.integrity = None
+        if config.kv_integrity:
+            from ..kvcache.integrity import BlockIntegrity
+
+            self.integrity = BlockIntegrity(
+                table_cap=config.kv_integrity_table_cap
+            )
+            self.block_manager.attach_integrity(
+                self.integrity, self._verify_host_slot
+            )
         # -- remote tier (REMOTE_TIER; off = none of this exists) ----------
         #: demotion payload sink, set by the serving layer (PodServer's
         #: background pusher) or the bench arm; None drops demotions on
@@ -532,6 +558,7 @@ class Engine:
                     init_hash=self.block_manager.token_db.init_hash,
                 ),
                 on_events=_store_events,
+                integrity=self.integrity,
             )
         if config.remote_tier:
             self.block_manager.attach_demoter(self._queue_demotion)
@@ -654,6 +681,94 @@ class Engine:
         self._pending_restores.append((page, src))
         self._restore_by_page[page] = src
 
+    # -- KV-block content integrity (KV_INTEGRITY) --------------------------
+    def _host_slot_digest(self, slot: int) -> int:
+        """Content digest of one host slot's STORED representation: int8
+        codes + scales under a quantized host tier, raw dtype bytes
+        otherwise — the exact bytes a restore reads back and a host-tier
+        export ships, so one digest spans spill→restore and
+        host→wire→store→pull-back."""
+        from ..kvcache.integrity import page_digest
+
+        if self._host_int8:
+            return page_digest(
+                self._host_k[slot].tobytes(),
+                self._host_v[slot].tobytes(),
+                self._host_k_scale[slot].tobytes(),
+                self._host_v_scale[slot].tobytes(),
+            )
+        return page_digest(
+            self._host_k[slot].tobytes(), self._host_v[slot].tobytes()
+        )
+
+    def _verify_host_slot(self, slot: int, h: int, reason: str) -> bool:
+        """Block-manager integrity hook: recompute the digest over the
+        host arrays for ``slot`` and compare against the write-time
+        record. Returns False ONLY for a corrupt copy (and quarantines it
+        first); a missing record passes — blocks spilled before the knob
+        (or whose queued offload has not flushed yet) are served on the
+        legacy trust model, never truncated on absence of evidence."""
+        from ..kvcache.integrity import CHECK_CORRUPT
+
+        outcome = self.integrity.check(h, self._host_slot_digest(slot), reason)
+        if outcome == CHECK_CORRUPT:
+            self.integrity.quarantine(h, tier="host_dram")
+            return False
+        return True
+
+    def scrub_host_pages(self, max_pages: int) -> int:
+        """Background integrity scrub, staged onto the engine loop by the
+        serving layer's scrub timer: flush queued page moves first (so
+        slot bytes — and their write-time digests — are committed, making
+        fresh spills verifiable), then verify a bounded rotating batch of
+        resident host slots. Corrupt copies quarantine with the full
+        recovery choreography; the resulting events flush immediately so
+        the fleet revokes without waiting for engine traffic."""
+        if self.integrity is None:
+            return 0
+        self._flush_page_moves()
+        n = self.block_manager.scrub_host_tier(max_pages)
+        if n:
+            self.block_manager.flush_events()
+        return n
+
+    def _verify_demote_src(self, info, src) -> bool:
+        """Pre-ship verify for a demotion snapshot: never push a payload
+        whose bytes already fail their write-time digest — shipping
+        poison just moves the quarantine to a peer. Only snapshots still
+        in the STORED representation are comparable against the side
+        table (int8 codes + scales, or full-width bytes on an
+        unquantized host tier); device-sourced or re-transformed
+        snapshots verify at the receiver via the payload digest instead.
+        A corrupt snapshot quarantines here: digest dropped, ledger
+        records the loss, and ``BadBlock`` revokes fleet-wide."""
+        from ..kvcache.integrity import CHECK_CORRUPT, page_digest
+        from ..kvcache.kvevents.events import BadBlock
+
+        if src[0] == "qdata":
+            d = page_digest(
+                src[1].tobytes(),
+                src[2].tobytes(),
+                src[3].tobytes(),
+                src[4].tobytes(),
+            )
+        elif src[0] == "data" and not self._host_int8:
+            d = page_digest(src[1].tobytes(), src[2].tobytes())
+        else:
+            return True
+        h = info.chain_hash
+        if self.integrity.check(h, d, "export") != CHECK_CORRUPT:
+            return True
+        self.integrity.quarantine(h, tier="host_dram")
+        self.block_manager._record_lifecycle(
+            h, "none", "quarantine", tenant=getattr(info, "tenant", "")
+        )
+        self.block_manager._emit(BadBlock(block_hashes=[h], medium="host_dram"))
+        log.warning(
+            "demotion payload failed digest check; quarantined", block=h
+        )
+        return False
+
     # -- remote-tier demotion (REMOTE_TIER) ---------------------------------
     def _queue_demotion(self, info, tier: str, idx: int) -> None:
         """Block-manager demotion hook: the last local copy of
@@ -702,6 +817,10 @@ class Engine:
         quantize_wire = self.config.kv_quant == "int8" or hbmq
         payloads = []
         for info, src in self._pending_demotions:
+            if self.integrity is not None and not self._verify_demote_src(
+                info, src
+            ):
+                continue
             extra = {}
             if src[0] == "qdata":
                 kd, vd = src[1], src[2]
@@ -736,19 +855,32 @@ class Engine:
                         "k_scale": sk.tobytes(),
                         "v_scale": sv.tobytes(),
                     }
-            payloads.append(
-                BlockPayload(
-                    block_hash=info.chain_hash,
-                    parent_block_hash=info.parent_hash,
-                    token_ids=list(info.token_ids),
-                    block_size=ps,
-                    dtype=str(np_dtype) if quantize_wire else str(kd.dtype),
-                    shape=shape,
-                    k_data=kd.tobytes(),
-                    v_data=vd.tobytes(),
-                    **extra,
-                )
+            payload = BlockPayload(
+                block_hash=info.chain_hash,
+                parent_block_hash=info.parent_hash,
+                token_ids=list(info.token_ids),
+                block_size=ps,
+                dtype=str(np_dtype) if quantize_wire else str(kd.dtype),
+                shape=shape,
+                k_data=kd.tobytes(),
+                v_data=vd.tobytes(),
+                **extra,
             )
+            if self.integrity is not None:
+                # Stamp the wire digest over the FINAL payload bytes (the
+                # representation the receiver stores and re-serves), and
+                # drop the local record — the last local copy is being
+                # destroyed; the digest now travels with the bytes.
+                from ..kvcache.integrity import page_digest
+
+                payload.digest = page_digest(
+                    payload.k_data,
+                    payload.v_data,
+                    payload.k_scale,
+                    payload.v_scale,
+                )
+                self.integrity.drop(info.chain_hash)
+            payloads.append(payload)
         self._pending_demotions.clear()
         self.remote_stats["demoted_blocks"] += len(payloads)
         self.remote_stats["demote_batches"] += 1
@@ -764,7 +896,7 @@ class Engine:
         (the store shares the event stream's ordering)."""
         if self.remote_store is None:
             return 0, 0
-        accepted = self.remote_store.accept(payloads)
+        accepted = self.remote_store.accept(payloads, source_pod=source_pod)
         if accepted:
             self.remote_stats["accepted_blocks"] += accepted
         return accepted, self.remote_store.headroom
@@ -959,6 +1091,24 @@ class Engine:
             for slot, src in self._pending_offloads:
                 self._host_k[slot], self._host_v[slot] = resolve(src)
 
+        if self.integrity is not None and self._pending_offloads:
+            # Write-time digests (KV_INTEGRITY): the slot bytes just
+            # landed and are hot in cache — record each written slot's
+            # stored-representation digest now, keyed by the block hash
+            # the block manager mapped to the slot. Reversed + seen-set:
+            # when a slot was written more than once this flush, only the
+            # LAST write's mapping is current.
+            seen: set = set()
+            for slot, _src in reversed(self._pending_offloads):
+                if slot in seen:
+                    continue
+                seen.add(slot)
+                info = self.block_manager._host_info.get(slot)
+                if info is not None and info.chain_hash is not None:
+                    self.integrity.record(
+                        info.chain_hash, self._host_slot_digest(slot)
+                    )
+
         if self._pending_restores:
             # Rate window starts HERE: a mixed flush must not charge the
             # offload snapshots' gather/memcpys to the restores (that
@@ -1152,24 +1302,58 @@ class Engine:
             dtype_s = str(np_dtype) if quantize_wire else str(kd.dtype)
             # tobytes() emits C-order bytes from any view — no
             # ascontiguousarray staging copy.
-            blocks.append(
-                BlockPayload(
-                    block_hash=h,
-                    parent_block_hash=info.parent_hash,
-                    token_ids=list(info.token_ids),
-                    block_size=self.page_size,
-                    dtype=dtype_s,
-                    shape=qshape,
-                    k_data=kd.tobytes(),
-                    v_data=vd.tobytes(),
-                    **extra,
-                )
+            payload = BlockPayload(
+                block_hash=h,
+                parent_block_hash=info.parent_hash,
+                token_ids=list(info.token_ids),
+                block_size=self.page_size,
+                dtype=dtype_s,
+                shape=qshape,
+                k_data=kd.tobytes(),
+                v_data=vd.tobytes(),
+                **extra,
             )
-        blocks.extend(remote_tail)
+            if self.integrity is not None:
+                from ..kvcache.integrity import CHECK_CORRUPT, page_digest
+
+                # Host-tier payload bytes ARE the stored slot bytes, so
+                # this digest doubles as the pre-serve verify against the
+                # write-time record. HBM blocks are freshly gathered from
+                # the trusted tier — their digest is stamped, not checked.
+                d = page_digest(
+                    payload.k_data,
+                    payload.v_data,
+                    payload.k_scale,
+                    payload.v_scale,
+                )
+                if (
+                    tier == "host_dram"
+                    and self.integrity.check(h, d, "export") == CHECK_CORRUPT
+                ):
+                    # Never ship poison: quarantine the host copy, revoke
+                    # fleet-wide, and truncate the export at the corrupt
+                    # block — the importer's stop-at-first-gap walk means
+                    # anything past it could never prefix-hit anyway.
+                    self.integrity.quarantine(h, tier="host_dram")
+                    self.block_manager.quarantine_host_block(h)
+                    self.block_manager.flush_events()
+                    truncated = True
+                    break
+                payload.digest = d
+            blocks.append(payload)
+        else:
+            truncated = False
+        if not truncated:
+            blocks.extend(remote_tail)
         self.transfer_stats["exported_blocks"] += len(blocks)
         return blocks
 
-    def import_kv_blocks(self, blocks, allow_evict: Optional[bool] = None) -> int:
+    def import_kv_blocks(
+        self,
+        blocks,
+        allow_evict: Optional[bool] = None,
+        source_pod: str = "",
+    ) -> int:
         """Install fetched prefix blocks as committed prefix-cache pages.
 
         Each block must extend a resident chain (its parent is the chain
@@ -1188,7 +1372,13 @@ class Engine:
         with the remote tier on, an import may recycle evictable LRU
         pages to make room (the victim spills to host or demotes over
         the fabric, so the trade is lossless); off keeps the legacy
-        free-pages-only rule."""
+        free-pages-only rule.
+
+        ``source_pod``: where the bytes came from (push sender, pull
+        endpoint, migration source). Under KV_INTEGRITY a payload whose
+        carried digest fails the recompute is rejected and a ``BadBlock``
+        naming that holder is published — the importer that catches a
+        peer's corrupt export is the one that revokes it fleet-wide."""
         from ..kvcache.kvblock.token_processor import hash_block
 
         if allow_evict is None:
@@ -1245,14 +1435,47 @@ class Engine:
             # block holds: the prefix cache's truth is this hash chain, so
             # an entry whose hash this engine would not itself compute
             # (tampered/corrupt payload, or a hash_seed-misaligned fleet)
-            # must never register. KV bytes are necessarily trusted —
-            # verifying them would be the recompute we are avoiding.
+            # must never register. The KV bytes themselves are covered by
+            # the carried content digest below when KV_INTEGRITY is on;
+            # with the knob off they are served on the legacy trust model
+            # (verifying without a digest would be the recompute we are
+            # avoiding).
             chain_parent = (
                 parent if parent is not None else self.block_manager.token_db.init_hash
             )
             if hash_block(chain_parent, blk.token_ids) != h:
                 self.transfer_stats["import_rejected"] += 1
                 break
+            if self.integrity is not None:
+                from ..kvcache.integrity import CHECK_CORRUPT, page_digest
+                from ..kvcache.kvevents.events import BadBlock
+
+                computed = page_digest(
+                    blk.k_data, blk.v_data, blk.k_scale, blk.v_scale
+                )
+                if (
+                    self.integrity.check_carried(
+                        h, blk.digest, computed, "import"
+                    )
+                    == CHECK_CORRUPT
+                ):
+                    # The bytes rotted between the exporter's write-time
+                    # digest and here (wire frame or the holder's store).
+                    # Reject, quarantine the identity locally, and revoke
+                    # the named holder's entry fleet-wide — then stop:
+                    # later blocks chain onto the one we just refused.
+                    self.transfer_stats["import_rejected"] += 1
+                    self.integrity.quarantine(h, tier="wire")
+                    self.block_manager._emit(
+                        BadBlock(block_hashes=[h], pod=source_pod)
+                    )
+                    self.block_manager.flush_events()
+                    log.warning(
+                        "imported KV payload failed digest check; rejected",
+                        block=h,
+                        source=source_pod or "<unknown>",
+                    )
+                    break
             try:
                 page = self.block_manager.install_imported_block(
                     h, parent, blk.token_ids, allow_evict=allow_evict

@@ -115,13 +115,16 @@ class _ServingMetrics:
         obs: bool = False,
         lifecycle: bool = False,
         tenant_qos: bool = False,
+        integrity: bool = False,
     ):
         """``obs``: build the PR-5 latency-decomposition histograms and
         engine-step telemetry series (``OBS_METRICS``). ``lifecycle``:
         build the ISSUE 15 block-lifecycle families (tier transitions,
         per-tier residency, reuse distance — fed by the ``OBS_LIFECYCLE``
         ledger/estimator). ``tenant_qos``: build the tenant-labeled SLO
-        burn gauge (``TENANT_QOS`` + ``OBS_SLO``). All off (default)
+        burn gauge (``TENANT_QOS`` + ``OBS_SLO``). ``integrity``: build
+        the ISSUE 19 digest-check/quarantine/scrub families (delta-synced
+        from the engine's ``BlockIntegrity`` counters). All off (default)
         keeps the exposition surface bit-identical to previous rounds."""
         # Measured serving rates (EMAs over request completions), kept
         # OUTSIDE the prometheus guard: admission control derives its
@@ -132,6 +135,7 @@ class _ServingMetrics:
         self._obs = bool(obs)
         self._lifecycle = bool(lifecycle)
         self._tenant_qos = bool(tenant_qos)
+        self._integrity = bool(integrity)
         try:
             import prometheus_client as prom
         except ImportError:  # pragma: no cover
@@ -400,6 +404,37 @@ class _ServingMetrics:
                 "sustainable rate)",
                 ["tenant", "objective", "window"], registry=self.registry,
             )
+        # KV-block integrity families (ISSUE 19, KV_INTEGRITY): built only
+        # under the knob so the default exposition surface stays
+        # unchanged; delta-synced from ``BlockIntegrity.stats`` on the
+        # engine loop (same pattern as spec/host).
+        if self._integrity:
+            self.integrity_checks = prom.Counter(
+                "kvcache_integrity_checks_total",
+                "KV-block content-digest checks at tier transitions, by "
+                "outcome (ok / corrupt / unverified = no recorded digest, "
+                "served on the legacy trust model)",
+                ["outcome"], registry=self.registry,
+            )
+            self.integrity_quarantined = prom.Counter(
+                "kvcache_integrity_quarantined_total",
+                "KV-block copies quarantined after a failed digest check "
+                "(chain truncated; suffix recomputed cold)",
+                registry=self.registry,
+            )
+            self.integrity_scrub_pages = prom.Counter(
+                "kvcache_integrity_scrub_pages_total",
+                "Resident host-tier slots verified by the background "
+                "integrity scrubber",
+                registry=self.registry,
+            )
+            self._integrity_seen = {
+                "checks_ok": 0,
+                "checks_corrupt": 0,
+                "checks_unverified": 0,
+                "quarantined": 0,
+                "scrub_pages": 0,
+            }
 
     def observe_tier_transition(self, frm: str, to: str, reason: str) -> None:
         if self._prom is None or not self._lifecycle:
@@ -419,6 +454,29 @@ class _ServingMetrics:
         self.reuse_distance.observe(
             min(distance_blocks, lifecycle_mod.COLD_DISTANCE_CLAMP)
         )
+
+    def sync_integrity_stats(self, stats: dict) -> None:
+        """Mirror the ``BlockIntegrity`` monotone counters into Prometheus
+        (delta sync, same pattern as spec/host/lifecycle)."""
+        if self._prom is None or not self._integrity:
+            return
+        for key, outcome in (
+            ("checks_ok", "ok"),
+            ("checks_corrupt", "corrupt"),
+            ("checks_unverified", "unverified"),
+        ):
+            d = stats.get(key, 0) - self._integrity_seen[key]
+            if d > 0:
+                self.integrity_checks.labels(outcome=outcome).inc(d)
+                self._integrity_seen[key] += d
+        for key, counter in (
+            ("quarantined", self.integrity_quarantined),
+            ("scrub_pages", self.integrity_scrub_pages),
+        ):
+            d = stats.get(key, 0) - self._integrity_seen[key]
+            if d > 0:
+                counter.inc(d)
+                self._integrity_seen[key] += d
 
     def set_slo_burn(self, objective: str, window: str, rate: float) -> None:
         if self._prom is None or not self._obs:
@@ -814,6 +872,21 @@ class PodServerConfig:
     #: rows, MRC slices, SLO burn rates). Unset (default) = no tenant
     #: dimension anywhere: bit-identical legacy behavior.
     tenant_qos: str = ""
+    # -- KV-block integrity (ISSUE 19; off by default = bit-identical ------
+    # -- legacy behavior, /stats fields, and wire bytes) --------------------
+    #: ``KV_INTEGRITY`` master switch (mirrored into the engine config):
+    #: write-time content digests on every host spill / demote / export,
+    #: verify-on-transition (restore, prefetch bring-back, remote
+    #: pull-back, transfer import, migration install), quarantine +
+    #: cold-recompute fallback on mismatch, and fleet-wide ``BadBlock``
+    #: revocation.
+    kv_integrity: bool = False
+    #: seconds between background scrub batches over resident host-tier
+    #: slots (``INTEGRITY_SCRUB_INTERVAL_S``); 0 = scrubber off. Scrub
+    #: batches run on the engine thread between steps.
+    integrity_scrub_interval_s: float = 0.0
+    #: host slots verified per scrub batch (``INTEGRITY_SCRUB_PAGES``)
+    integrity_scrub_pages: int = 32
     engine: EngineConfig = field(default_factory=EngineConfig)
 
     @classmethod
@@ -917,6 +990,16 @@ class PodServerConfig:
         cfg.fleet_controller = _env_bool("FLEET_CONTROLLER", "0")
         # Multi-tenant QoS (ISSUE 18; unset/empty = off, legacy behavior).
         cfg.tenant_qos = os.environ.get("TENANT_QOS", cfg.tenant_qos)
+        # KV-block integrity (ISSUE 19; 0/unset = off, legacy behavior).
+        cfg.kv_integrity = _env_bool("KV_INTEGRITY", "0")
+        cfg.integrity_scrub_interval_s = float(
+            os.environ.get(
+                "INTEGRITY_SCRUB_INTERVAL_S", cfg.integrity_scrub_interval_s
+            )
+        )
+        cfg.integrity_scrub_pages = int(
+            os.environ.get("INTEGRITY_SCRUB_PAGES", cfg.integrity_scrub_pages)
+        )
 
         eng = cfg.engine
         eng.block_manager = BlockManagerConfig(
@@ -996,6 +1079,12 @@ class PodServerConfig:
         eng.remote_store_pages = (
             cfg.remote_store_pages if cfg.remote_tier else 0
         )
+        # KV integrity reaches the engine (digest table, verify hooks)
+        # through its own config.
+        eng.kv_integrity = cfg.kv_integrity
+        eng.kv_integrity_table_cap = int(
+            os.environ.get("INTEGRITY_TABLE_CAP", eng.kv_integrity_table_cap)
+        )
         return cfg
 
 
@@ -1029,6 +1118,10 @@ class PodServer:
             # engines configure themselves.
             self.config.engine.remote_tier = True
             self.config.engine.remote_store_pages = self.config.remote_store_pages
+        if self.config.kv_integrity and engine is None:
+            # Same pattern for the integrity plane (ISSUE 19): the digest
+            # table + verify hooks attach inside the engine ctor.
+            self.config.engine.kv_integrity = True
         self._tokenizer = tokenizer
         self.transfer_cost_model = transfer_cost_model
         #: request tracing (OBS_TRACING); a disabled tracer hands out one
@@ -1088,7 +1181,13 @@ class PodServer:
             obs=self.config.obs_metrics,
             lifecycle=self.config.obs_lifecycle,
             tenant_qos=bool(self.config.tenant_qos.strip()),
+            integrity=self.config.kv_integrity,
         )
+        # -- KV-block integrity plane (ISSUE 19; off = None, no hooks) -----
+        #: the engine's ``BlockIntegrity`` (digest table + quarantine set),
+        #: or None when KV_INTEGRITY is off / the injected engine has none.
+        self.integrity = getattr(self.engine, "integrity", None)
+        self._integrity_quarantine_seen = 0  # loop-thread-only
         # -- multi-tenant QoS (ISSUE 18; off = None, no hooks anywhere) ----
         #: parsed TENANT_QOS policy table + per-tenant admission budgets.
         #: A malformed spec raises HERE, at construction — a silently
@@ -1163,7 +1262,7 @@ class PodServer:
         # thread allowed to touch page pools (the service/HTTP threads just
         # park on a Future) — same ownership rule as request admission.
         self._transfer_exports: deque[tuple[list[int], Optional[int], Future]] = deque()  # guarded_by: _mu|_work
-        self._transfer_imports: deque[tuple[list, Future]] = deque()  # guarded_by: _mu|_work
+        self._transfer_imports: deque[tuple[list, str, Future]] = deque()  # guarded_by: _mu|_work
         #: per-endpoint DEALER reuse shared by pull_prefix, async-pull
         #: workers and demotion pushes — repeat traffic to one peer rides
         #: one connected socket (dial/reuse counters on the clients).
@@ -1233,6 +1332,9 @@ class PodServer:
         self.snapshots_published = 0  # guarded_by: _mu|_work
         self._self_heal_stop = threading.Event()
         self._self_heal_thread: Optional[threading.Thread] = None
+        # -- background integrity scrubber (KV_INTEGRITY + interval > 0) ----
+        self._scrub_stop = threading.Event()
+        self._scrub_thread: Optional[threading.Thread] = None
         # -- remote tier (REMOTE_TIER; off = none of this runs) -------------
         #: demotion pushes from peers staged for the engine loop (the
         #: remote store shares the event stream's ordering)
@@ -1329,6 +1431,15 @@ class PodServer:
                 target=self._self_heal_loop, name="self-heal", daemon=True
             )
             self._self_heal_thread.start()
+        if (
+            self.integrity is not None
+            and self.config.integrity_scrub_interval_s > 0
+        ):
+            self._scrub_stop.clear()
+            self._scrub_thread = threading.Thread(
+                target=self._scrub_loop, name="kv-scrub", daemon=True
+            )
+            self._scrub_thread.start()
 
     def drain(self, timeout_s: Optional[float] = None) -> bool:
         """Graceful drain for rolling restarts. Flips the pod to draining
@@ -1454,6 +1565,10 @@ class PodServer:
         if self._self_heal_thread is not None:
             self._self_heal_thread.join(timeout=5)
             self._self_heal_thread = None
+        self._scrub_stop.set()
+        if self._scrub_thread is not None:
+            self._scrub_thread.join(timeout=5)
+            self._scrub_thread = None
         self._demote_stop.set()
         if self._demote_thread is not None:
             self._demote_thread.join(timeout=10)
@@ -1768,9 +1883,13 @@ class PodServer:
                         fut.set_result(self.engine.block_digest())
                     except Exception as e:
                         fut.set_exception(e)
-                for blocks, fut in imports:
+                for blocks, src_pod, fut in imports:
                     try:
-                        fut.set_result(self.engine.import_kv_blocks(blocks))
+                        fut.set_result(
+                            self.engine.import_kv_blocks(
+                                blocks, source_pod=src_pod
+                            )
+                        )
                     except Exception as e:
                         fut.set_exception(e)
                 for source_pod, blocks, fut in pushes:
@@ -1933,6 +2052,23 @@ class PodServer:
                     self.metrics.sync_lifecycle_stats(
                         self.engine.lifecycle_stats
                     )
+                    if self.integrity is not None:
+                        istats = self.integrity.stats
+                        q = istats["quarantined"]
+                        if q > self._integrity_quarantine_seen:
+                            # A corrupt block surfaced this step: preserve
+                            # the forensic window around it (step ring,
+                            # recent lifecycle) before it scrolls away.
+                            delta = q - self._integrity_quarantine_seen
+                            self._integrity_quarantine_seen = q
+                            self._flight_event(
+                                "kv_quarantine", blocks=delta
+                            )
+                            if self.flight is not None:
+                                self.flight.trigger(
+                                    "quarantine", blocks=delta
+                                )
+                        self.metrics.sync_integrity_stats(istats)
                     if obs:
                         self._loop_prev_end = time.perf_counter()
                         self._loop_had_work = self.engine.has_ready_work
@@ -1984,6 +2120,25 @@ class PodServer:
                 # heartbeats behind a long device step — a slow resync must
                 # never make a live pod look dead.
                 self.publish_index_snapshot(wait=False)
+
+    def _scrub_loop(self) -> None:
+        """Background integrity scrubber (KV_INTEGRITY=1 +
+        ``INTEGRITY_SCRUB_INTERVAL_S`` > 0): every interval, hop onto the
+        engine loop and re-digest a bounded batch of resident host-tier
+        pages. Latent rot (a cosmic-ray flip in a page nothing is reading)
+        surfaces within ``pages / rate`` instead of at restore time — or
+        never, if the chain dies cold. Failures are swallowed: the
+        scrubber must never take a serving pod down."""
+        interval = self.config.integrity_scrub_interval_s
+        while not self._scrub_stop.wait(interval):
+            try:
+                self._controller_read(
+                    lambda: self.engine.scrub_host_pages(
+                        self.config.integrity_scrub_pages
+                    )
+                )
+            except Exception as e:
+                log.warning("integrity scrub pass failed", error=repr(e))
 
     def _publish_heartbeat(self) -> None:
         if self._publisher is None:
@@ -2089,16 +2244,18 @@ class PodServer:
             self._work.notify()
         return fut.result(timeout=max(self.config.transfer_timeout_s * 3, 30.0))
 
-    def submit_import(self, blocks: list) -> Future:
+    def submit_import(self, blocks: list, source_pod: str = "") -> Future:
         """Stage fetched blocks for installation on the engine loop; the
-        Future resolves to the number of blocks imported."""
+        Future resolves to the number of blocks imported. ``source_pod``
+        (the peer endpoint the blocks were pulled from) contextualizes
+        integrity rejects and their ``BadBlock`` revocations."""
         fut: Future = Future()
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"engine failed: {self._failed}")
             if not self._running:
                 raise RuntimeError("pod server not running")
-            self._transfer_imports.append((blocks, fut))
+            self._transfer_imports.append((blocks, source_pod, fut))
             self._work.notify()
         return fut
 
@@ -2247,6 +2404,36 @@ class PodServer:
         with self._mu:
             return self._migrated_in_futures.get(request_id)
 
+    def purge_bad_blocks(
+        self, holder: str, block_hashes: list, medium=None
+    ) -> int:
+        """Fleet-revocation consumer (ISSUE 19): a ``BadBlock`` published
+        by ``holder`` reached the control plane; destroy any replica
+        copies this pod's remote store still holds for those hashes (the
+        wire-ready bytes a demotion pushed here — the only copies that
+        share provenance with the corrupt ones; locally computed pages
+        are independent and stay). Engine-loop hop, since the store is
+        engine-thread-owned. Returns blocks dropped; 0 when the holder is
+        this pod (its copy died at quarantine time) or there is no store.
+        Input-driven, not knob-gated — a legacy pod honors revocations
+        too."""
+        if (
+            self.engine.remote_store is None
+            or not block_hashes
+            or holder == self.config.pod_identifier
+        ):
+            return 0
+        try:
+            return (
+                self._controller_read(
+                    lambda: self.engine.remote_store.purge(block_hashes)
+                )
+                or 0
+            )
+        except Exception as e:
+            log.warning("bad-block purge failed", error=repr(e))
+            return 0
+
     def _controller_read(self, call):
         """Run a zero-arg callable on the engine loop and wait — the fleet
         controller's read hop into engine-owned state (scheduler deques,
@@ -2313,7 +2500,7 @@ class PodServer:
             )
             if not blocks:
                 return 0
-            return self.submit_import(blocks).result(
+            return self.submit_import(blocks, source_pod=source_endpoint).result(
                 timeout=timeout_s or max(self.config.transfer_timeout_s * 3, 30.0)
             )
         except (TransferError, RuntimeError, FuturesTimeout) as e:
@@ -2393,7 +2580,9 @@ class PodServer:
         installed = 0
         if migration.blocks:
             try:
-                installed = self.engine.import_kv_blocks(migration.blocks)
+                installed = self.engine.import_kv_blocks(
+                    migration.blocks, source_pod=source_pod
+                )
             except Exception:
                 # Geometry/chain verification failures already degrade
                 # inside import_kv_blocks; anything past that just means
@@ -2658,7 +2847,9 @@ class PodServer:
                 outcome = "canceled"
                 return
             imported = (
-                self.submit_import(blocks).result(timeout=wait_timeout)
+                self.submit_import(blocks, source_pod=source).result(
+                    timeout=wait_timeout
+                )
                 if blocks
                 else 0
             )
@@ -2767,7 +2958,9 @@ class PodServer:
                 ),
             )
             imported = (
-                self.submit_import(blocks).result(timeout=wait_timeout)
+                self.submit_import(blocks, source_pod=source_endpoint).result(
+                    timeout=wait_timeout
+                )
                 if blocks
                 else 0
             )
@@ -3364,6 +3557,10 @@ class PodServer:
                     **dict(bm.host_stats),
                     "prefetch": dict(self.engine.host_prefetch_stats),
                 }
+            if self.integrity is not None:
+                # Integrity block only with KV_INTEGRITY on: the knobs-off
+                # /stats payload stays bit-identical.
+                payload["integrity"] = self.integrity.snapshot()
             if self.config.engine.kv_quant_hbm is not None:
                 # Only when the HBM-quant knob is on: the knobs-off /stats
                 # payload stays bit-identical (same rule as every tier

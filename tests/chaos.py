@@ -142,6 +142,52 @@ class ChaosLink:
         pass
 
 
+# -- byte-corruption faults (ISSUE 19) ---------------------------------------
+# Bit rot injected at rest or in flight: each helper flips bits in the
+# REAL stored representation (host slot arrays, a remote store's
+# wire-ready payload, an in-transit ``BlockPayload``), so the integrity
+# plane's digests are exercised against exactly the bytes it guards.
+
+
+def corrupt_host_slot(server_or_engine, chain_hash, byte_index=0) -> bool:
+    """Flip one byte of the host-DRAM copy of ``chain_hash`` in place
+    (accepts a ``PodServer`` or a bare ``Engine``). Returns False when
+    the block is not host-resident. Flushes any pending page moves first
+    so the digest of record predates the flip."""
+    eng = getattr(server_or_engine, "engine", server_or_engine)
+    eng._flush_page_moves()
+    bm = eng.block_manager
+    slot = bm._host_cached.get(chain_hash)
+    if slot is None:
+        return False
+    flat = eng._host_k[slot].reshape(-1).view("uint8")
+    flat[byte_index % flat.size] ^= 0xFF
+    return True
+
+
+def corrupt_remote_block(store, chain_hash, byte_index=0) -> bool:
+    """Flip one byte of a remote store's wire-ready copy in place (rot at
+    rest on the holder). Returns False when the store has no such
+    block."""
+    blk = store._blocks.get(chain_hash)
+    if blk is None:
+        return False
+    data = bytearray(blk.k_data)
+    data[byte_index % len(data)] ^= 0xFF
+    blk.k_data = bytes(data)
+    return True
+
+
+def corrupt_payload(blocks, which=0, byte_index=0):
+    """Flip one byte in an in-flight ``BlockPayload`` list (wire frame
+    corruption between encode and install) and return the same list."""
+    blk = blocks[which]
+    data = bytearray(blk.v_data)
+    data[byte_index % len(data)] ^= 0xFF
+    blk.v_data = bytes(data)
+    return blocks
+
+
 # -- ground truth vs index view ---------------------------------------------
 def engine_truth(server) -> set[int]:
     """Every chain hash resident on the pod, across tiers (the digest a

@@ -1,0 +1,700 @@
+"""End-to-end KV-block integrity suite (ISSUE 19 acceptance).
+
+- **Digest unit**: chained crc32 over the exact stored/wire bytes
+  (scales included), order-sensitive, deterministic.
+- **BlockIntegrity**: record/check outcomes (ok / corrupt / unverified —
+  absence of evidence never truncates), quarantine bookkeeping, LRU
+  table cap, thread-safe snapshot.
+- **Corruption drills**, one per tier, each asserting the full contract:
+  the flip is detected BEFORE any token is emitted from poisoned bytes,
+  the chain truncates at the bad suffix, generation recomputes to greedy
+  parity with a never-corrupted baseline, and pages return to baseline.
+  - host DRAM: rot caught at restore time and by the background scrubber
+  - remote store: rot at rest caught at serve time, with the holder's
+    ``BadBlock(remote)`` + ``BlockRemoved(remote)`` pair
+  - in flight: a corrupted ``BlockPayload`` frame is rejected at import
+    (install stops at the bad frame) and at remote-store accept
+- **Export truncation**: a corrupt host block is caught while BUILDING
+  an export — the response truncates at the bad suffix instead of
+  shipping poisoned bytes.
+- **Fleet revocation conformance**: a ``BadBlock`` event through the
+  events pool drops the holder's index entry on every backend
+  (in-memory, cost-aware, redis, instrumented, native) and through
+  ``ShardedIndex``/``ShardedEventsPool``; replica purges fan out via
+  ``on_bad_block``; routes already in flight attribute as
+  ``quarantined``.
+- **Knobs-off parity pins**: KV_INTEGRITY off = no digest table, no
+  wire digests (encode bytes pinned), legacy /stats keys, no
+  ``kvcache_integrity_*`` exposition.
+- **Hammer**: concurrent record/check/quarantine over the digest table
+  (runs under LOCKTRACE=1 in CI).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from chaos import corrupt_host_slot, corrupt_payload, corrupt_remote_block
+from fake_redis import FakeRedis
+from llm_d_kv_cache_manager_tpu.kvcache.integrity import (
+    CHECK_CORRUPT,
+    CHECK_OK,
+    CHECK_UNVERIFIED,
+    BlockIntegrity,
+    page_digest,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock import (
+    CostAwareMemoryIndex,
+    CostAwareMemoryIndexConfig,
+    DeviceTier,
+    InMemoryIndex,
+    InMemoryIndexConfig,
+    InstrumentedIndex,
+    Key,
+    NativeMemoryIndex,
+    NativeMemoryIndexConfig,
+    PodEntry,
+    RedisIndexConfig,
+    native_available,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvblock.redis_index import RedisIndex
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents import (
+    EventBatch,
+    KVEventsPool,
+    KVEventsPoolConfig,
+    Message,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents.events import (
+    BadBlock,
+    decode_event_batch,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.kvevents.health import (
+    FleetHealth,
+    FleetHealthConfig,
+)
+from llm_d_kv_cache_manager_tpu.kvcache.transfer import (
+    RemoteBlockStore,
+    RemoteStoreConfig,
+    protocol,
+)
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA, quant
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    Engine,
+    EngineConfig,
+    SamplingParams,
+    SchedulerConfig,
+)
+
+PS = 4
+MODEL = "tiny-llama"
+SHAPE = (TINY_LLAMA.n_layers, PS, TINY_LLAMA.n_kv_heads, TINY_LLAMA.hd)
+SCALE_BYTES = int(np.prod(quant.kv_scale_shape(SHAPE))) * 4
+
+
+def _engine_cfg(total_pages=64, **kw):
+    return EngineConfig(
+        model=TINY_LLAMA,
+        block_manager=BlockManagerConfig(
+            total_pages=total_pages,
+            page_size=PS,
+            host_pages=kw.pop("host_pages", 0),
+        ),
+        scheduler=SchedulerConfig(max_prefill_batch=4),
+        max_model_len=64,
+        decode_batch_size=4,
+        prefill_bucket=8,
+        interpret=True,
+        **kw,
+    )
+
+
+def _engine(total_pages=64, on_events=None, **kw):
+    return Engine(_engine_cfg(total_pages=total_pages, **kw), on_events=on_events)
+
+
+def _prompt(seed, n):
+    return list(
+        map(int, np.random.default_rng(seed).integers(0, TINY_LLAMA.vocab_size, n))
+    )
+
+
+def _baseline(n=5, tokens=4):
+    """Greedy outputs from a never-evicted, never-corrupted engine."""
+    base = _engine(total_pages=64)
+    want = {}
+    for i in range(n):
+        seq = base.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=tokens))
+        base.run_until_complete()
+        want[i] = list(seq.generated_tokens)
+    return want
+
+
+def _store(eng, capacity=256, on_events=None):
+    return RemoteBlockStore(
+        RemoteStoreConfig(
+            capacity_pages=capacity,
+            page_size=PS,
+            page_shape=SHAPE,
+            dtype="float32",
+            scale_bytes=SCALE_BYTES,
+            init_hash=eng.block_manager.token_db.init_hash,
+        ),
+        on_events=on_events,
+        integrity=eng.integrity,
+    )
+
+
+# -- digest + table units -----------------------------------------------------
+class TestPageDigest:
+    def test_deterministic_and_order_sensitive(self):
+        assert page_digest(b"kk", b"vv") == page_digest(b"kk", b"vv")
+        assert page_digest(b"kk", b"vv") != page_digest(b"vv", b"kk")
+        assert page_digest(b"kk", b"vv") != page_digest(b"kkv", b"v")
+
+    def test_scales_are_covered(self):
+        base = page_digest(b"k", b"v")
+        assert page_digest(b"k", b"v", b"s", b"") != base
+        assert page_digest(b"k", b"v", b"", b"s") != base
+        assert page_digest(b"k", b"v", b"a", b"b") != page_digest(
+            b"k", b"v", b"b", b"a"
+        )
+
+    def test_fits_u32(self):
+        d = page_digest(b"\xff" * 1024, b"\x00" * 1024)
+        assert 0 <= d <= 0xFFFFFFFF
+
+
+class TestBlockIntegrity:
+    def test_check_outcomes(self):
+        bi = BlockIntegrity()
+        d = page_digest(b"k", b"v")
+        bi.record(7, d)
+        assert bi.check(7, d, "restore") == CHECK_OK
+        assert bi.check(8, d, "restore") == CHECK_UNVERIFIED  # no evidence
+        assert bi.check(7, d ^ 1, "restore") == CHECK_CORRUPT
+        s = bi.stats
+        assert (s["checks_ok"], s["checks_unverified"], s["checks_corrupt"]) == (
+            1,
+            1,
+            1,
+        )
+
+    def test_carried_digest_none_is_unverified(self):
+        bi = BlockIntegrity()
+        assert bi.check_carried(1, None, 123, "import") == CHECK_UNVERIFIED
+        assert bi.check_carried(1, 123, 123, "import") == CHECK_OK
+        assert bi.check_carried(1, 122, 123, "import") == CHECK_CORRUPT
+
+    def test_quarantine_drops_digest_and_marks(self):
+        bi = BlockIntegrity()
+        bi.record(7, 1)
+        bi.quarantine(7, tier="host_dram")
+        assert bi.is_quarantined(7)
+        assert bi.expected(7) is None
+        # Re-recording (a fresh, recomputed copy) clears the flag.
+        bi.record(7, 2)
+        assert not bi.is_quarantined(7)
+
+    def test_table_cap_evicts_lru(self):
+        bi = BlockIntegrity(table_cap=4)
+        for h in range(6):
+            bi.record(h, h)
+        assert len(bi) == 4
+        assert bi.expected(0) is None and bi.expected(5) == 5
+        assert bi.stats["table_evictions"] == 2
+
+    def test_snapshot_shape(self):
+        bi = BlockIntegrity()
+        bi.record(1, 1)
+        snap = bi.snapshot()
+        assert snap["table_entries"] == 1
+        assert snap["quarantine_entries"] == 0
+        assert "recorded" in snap and "checks_corrupt" in snap
+
+
+# -- corruption drills --------------------------------------------------------
+class TestHostTierDrill:
+    def test_restore_detects_quarantines_and_recomputes(self):
+        want = _baseline(4)
+        eng = _engine(
+            total_pages=12,
+            host_pages=32,
+            host_tier_policy="always",
+            kv_integrity=True,
+        )
+        events = []
+        eng.block_manager.on_events = events.extend
+        for i in range(4):
+            seq = eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+            assert list(seq.generated_tokens) == want[i]
+        free_before = eng.block_manager.num_free
+        bm = eng.block_manager
+        hashes = bm.token_db.prefix_hashes(_prompt(0, 16))
+        assert corrupt_host_slot(
+            eng, hashes[0]
+        ), "chain 0 must be host-resident for the drill"
+        # Re-serve prompt 0: the bring-back MUST catch the flip before any
+        # token is emitted, quarantine the block, and recompute cold to
+        # exact greedy parity.
+        seq = eng.add_request(_prompt(0, 16), SamplingParams(max_new_tokens=4))
+        eng.run_until_complete()
+        assert list(seq.generated_tokens) == want[0]
+        assert eng.integrity.stats["checks_corrupt"] >= 1
+        assert eng.integrity.stats["quarantined"] >= 1
+        assert eng.integrity.is_quarantined(hashes[0]) or hashes[0] in bm._host_cached
+        bad = [e for e in events if isinstance(e, BadBlock)]
+        assert bad and bad[0].medium == "host_dram"
+        assert hashes[0] in bad[0].block_hashes
+        # Pages back to baseline: nothing leaked across the quarantine.
+        eng._flush_page_moves()
+        assert eng.block_manager.num_free == free_before
+
+    def test_scrubber_catches_latent_rot(self):
+        eng = _engine(
+            total_pages=12,
+            host_pages=32,
+            host_tier_policy="always",
+            kv_integrity=True,
+        )
+        for i in range(4):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        eng._flush_page_moves()
+        bm = eng.block_manager
+        assert bm._host_cached, "no host-resident pages to scrub"
+        victim = next(iter(bm._host_cached))
+        slot = bm._host_cached[victim]
+        eng._host_k[slot].reshape(-1).view("uint8")[3] ^= 0x80
+        checked = eng.scrub_host_pages(64)
+        assert checked > 0
+        assert eng.integrity.stats["checks_corrupt"] == 1
+        assert eng.integrity.stats["scrub_pages"] == checked
+        assert victim not in bm._host_cached  # quarantined, not servable
+        assert eng.integrity.is_quarantined(victim)
+
+    def test_scrub_clean_tier_is_all_ok(self):
+        eng = _engine(
+            total_pages=12,
+            host_pages=32,
+            host_tier_policy="always",
+            kv_integrity=True,
+        )
+        for i in range(3):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        checked = eng.scrub_host_pages(64)
+        assert checked > 0
+        assert eng.integrity.stats["checks_corrupt"] == 0
+        assert eng.integrity.stats["checks_ok"] == checked
+
+
+class TestRemoteTierDrill:
+    def test_serve_detects_rot_revokes_and_recomputes(self):
+        want = _baseline(5)
+        eng = _engine(total_pages=12, remote_tier=True, kv_integrity=True)
+        events = []
+        store = _store(eng, on_events=events.extend)
+        eng.on_demotion = store.accept
+        for i in range(5):
+            seq = eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+            assert list(seq.generated_tokens) == want[i]
+        # Demoted payloads carry their digests.
+        assert len(store) > 0
+        assert all(b.digest is not None for b in store._blocks.values())
+        hashes = eng.block_manager.token_db.prefix_hashes(_prompt(0, 16))
+        assert hashes[0] in store
+        assert corrupt_remote_block(store, hashes[0])
+        served = store.serve(hashes)
+        # The rotted head breaks the run before ANY payload ships.
+        assert served == []
+        assert store.stats["quarantined"] == 1
+        assert hashes[0] not in store
+        removed = [e for e in events if type(e).__name__ == "BlockRemoved"]
+        bad = [e for e in events if isinstance(e, BadBlock)]
+        assert any(hashes[0] in e.block_hashes for e in removed)
+        assert bad and bad[0].medium == "remote"
+        # Cold recompute: greedy parity with the never-corrupted baseline.
+        seq = eng.add_request(_prompt(0, 16), SamplingParams(max_new_tokens=4))
+        eng.run_until_complete()
+        assert list(seq.generated_tokens) == want[0]
+
+    def test_accept_rejects_corrupt_push(self):
+        eng = _engine(total_pages=12, remote_tier=True, kv_integrity=True)
+        payloads = []
+        eng.on_demotion = payloads.extend
+        for i in range(5):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        assert payloads
+        store = _store(eng)
+        corrupt_payload(payloads, which=0)
+        accepted = store.accept(payloads, source_pod="pusher-1")
+        assert accepted == len(payloads) - 1
+        assert store.stats["digest_rejected"] == 1
+        assert payloads[0].block_hash not in store
+
+    def test_purge_drops_revoked_replicas(self):
+        eng = _engine(total_pages=12, remote_tier=True, kv_integrity=True)
+        events = []
+        store = _store(eng, on_events=events.extend)
+        eng.on_demotion = store.accept
+        for i in range(5):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        victims = list(store._blocks)[:2]
+        assert store.purge(victims + [999]) == 2
+        assert all(h not in store for h in victims)
+        assert store.stats["purged"] == 2
+        removed = [e for e in events if type(e).__name__ == "BlockRemoved"]
+        assert any(set(victims) <= set(e.block_hashes) for e in removed)
+
+
+class TestInFlightDrill:
+    def test_import_rejects_corrupt_frame_before_install(self):
+        want = _baseline(2)
+        donor = _engine(total_pages=64, kv_integrity=True)
+        donor.add_request(_prompt(1, 16), SamplingParams(max_new_tokens=1))
+        donor.run_until_complete()
+        hashes = donor.block_manager.token_db.prefix_hashes(_prompt(1, 16))
+        blocks = donor.export_kv_blocks(hashes)
+        assert blocks and all(b.digest is not None for b in blocks)
+        events = []
+        recv = _engine(total_pages=64, kv_integrity=True, on_events=events.extend)
+        corrupt_payload(blocks, which=1)
+        # Installs the clean prefix, stops AT the corrupt frame — the
+        # poisoned bytes never reach a page pool.
+        assert recv.import_kv_blocks(blocks, source_pod="donor-pod") == 1
+        assert recv.transfer_stats["import_rejected"] == 1
+        assert recv.integrity.stats["checks_corrupt"] == 1
+        bad = [e for e in events if isinstance(e, BadBlock)]
+        assert bad and bad[0].pod == "donor-pod"
+        assert blocks[1].block_hash in bad[0].block_hashes
+        # Greedy parity: the gap recomputes, zero corrupted tokens.
+        seq = recv.add_request(_prompt(1, 16), SamplingParams(max_new_tokens=4))
+        recv.run_until_complete()
+        assert list(seq.generated_tokens) == want[1]
+
+    def test_wire_round_trip_preserves_digest(self):
+        donor = _engine(total_pages=64, kv_integrity=True)
+        donor.add_request(_prompt(1, 16), SamplingParams(max_new_tokens=1))
+        donor.run_until_complete()
+        hashes = donor.block_manager.token_db.prefix_hashes(_prompt(1, 16))
+        blocks = donor.export_kv_blocks(hashes)
+        got = protocol.decode_response(protocol.encode_response(blocks, True))
+        assert got is not None
+        decoded, _complete, err = got
+        assert err is None
+        assert [b.digest for b in decoded] == [b.digest for b in blocks]
+        assert all(b.digest is not None for b in decoded)
+
+
+class TestExportTruncation:
+    def test_export_truncates_at_corrupt_host_block(self):
+        eng = _engine(
+            total_pages=12,
+            host_pages=32,
+            host_tier_policy="always",
+            kv_integrity=True,
+        )
+        for i in range(4):
+            eng.add_request(_prompt(i, 16), SamplingParams(max_new_tokens=4))
+            eng.run_until_complete()
+        eng._flush_page_moves()
+        bm = eng.block_manager
+        hashes = bm.token_db.prefix_hashes(_prompt(0, 16))
+        host_run = [h for h in hashes if h in bm._host_cached]
+        assert len(host_run) >= 2, "need a multi-block host run"
+        # Corrupt the SECOND host block of the chain: the export must ship
+        # the clean prefix and truncate at the bad suffix.
+        bad = host_run[1]
+        slot = bm._host_cached[bad]
+        eng._host_k[slot].reshape(-1).view("uint8")[0] ^= 0xFF
+        blocks = eng.export_kv_blocks(hashes)
+        assert [b.block_hash for b in blocks] == hashes[: hashes.index(bad)]
+        assert eng.integrity.stats["checks_corrupt"] == 1
+        assert bad not in bm._host_cached  # quarantined on detection
+
+
+# -- fleet-wide revocation conformance ---------------------------------------
+BACKENDS = {
+    "in_memory": lambda: InMemoryIndex(
+        InMemoryIndexConfig(size=1000, pod_cache_size=10)
+    ),
+    "cost_aware": lambda: CostAwareMemoryIndex(
+        CostAwareMemoryIndexConfig(max_cost_bytes=10**6)
+    ),
+    "redis": lambda: RedisIndex(RedisIndexConfig(client=FakeRedis())),
+    "instrumented": lambda: InstrumentedIndex(
+        InMemoryIndex(InMemoryIndexConfig(size=1000, pod_cache_size=10))
+    ),
+}
+if native_available():
+    BACKENDS["native"] = lambda: NativeMemoryIndex(
+        NativeMemoryIndexConfig(size=1000, pod_cache_size=10)
+    )
+
+
+def _bad_payload(hashes, pod="", medium=None):
+    return EventBatch(
+        ts=0.0, events=[BadBlock(block_hashes=hashes, pod=pod, medium=medium)]
+    ).to_payload()
+
+
+@pytest.fixture(params=list(BACKENDS))
+def index(request):
+    return BACKENDS[request.param]()
+
+
+class TestRevocationConformance:
+    def _pool(self, index, **kw):
+        pool = KVEventsPool(index, KVEventsPoolConfig(concurrency=1), **kw)
+        pool.start()
+        return pool
+
+    def test_bad_block_revokes_all_tiers(self, index):
+        index.add([Key(MODEL, 7)], [PodEntry("pod-1", DeviceTier.TPU_HBM)])
+        index.add([Key(MODEL, 7)], [PodEntry("pod-1", DeviceTier.HOST_DRAM)])
+        pool = self._pool(index)
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _bad_payload([7])))
+            assert pool.drain()
+            assert index.lookup([Key(MODEL, 7)], set()).get(Key(MODEL, 7), []) == []
+        finally:
+            pool.shutdown()
+
+    def test_bad_block_medium_scoped(self, index):
+        index.add([Key(MODEL, 7)], [PodEntry("pod-1", DeviceTier.TPU_HBM)])
+        index.add([Key(MODEL, 7)], [PodEntry("pod-1", DeviceTier.HOST_DRAM)])
+        pool = self._pool(index)
+        try:
+            pool.add_task(
+                Message("t", "pod-1", MODEL, _bad_payload([7], medium="host_dram"))
+            )
+            assert pool.drain()
+            # The HBM entry survives a host_dram-scoped revocation.
+            assert index.lookup([Key(MODEL, 7)], set())[Key(MODEL, 7)] == ["pod-1"]
+        finally:
+            pool.shutdown()
+
+    def test_bad_block_holder_identity(self, index):
+        """A detector publishing on a peer's behalf (``ev.pod``) revokes
+        the HOLDER's entry, not its own."""
+        index.add([Key(MODEL, 7)], [PodEntry("holder-pod", DeviceTier.REMOTE)])
+        index.add([Key(MODEL, 7)], [PodEntry("detector-pod", DeviceTier.TPU_HBM)])
+        pool = self._pool(index)
+        try:
+            pool.add_task(
+                Message(
+                    "t",
+                    "detector-pod",
+                    MODEL,
+                    _bad_payload([7], pod="holder-pod", medium="remote"),
+                )
+            )
+            assert pool.drain()
+            assert index.lookup([Key(MODEL, 7)], set())[Key(MODEL, 7)] == [
+                "detector-pod"
+            ]
+        finally:
+            pool.shutdown()
+
+    def test_on_bad_block_purge_fans_out(self, index):
+        calls = []
+        pool = self._pool(
+            index, on_bad_block=lambda pod, hs, m: calls.append((pod, hs, m))
+        )
+        try:
+            pool.add_task(
+                Message("t", "pod-1", MODEL, _bad_payload([7, 8], medium="remote"))
+            )
+            assert pool.drain()
+            assert calls == [("pod-1", [7, 8], "remote")]
+        finally:
+            pool.shutdown()
+
+    def test_health_counts_bad_blocks_without_liveness_impact(self, index):
+        health = FleetHealth(FleetHealthConfig(pod_ttl_s=0))
+        pool = self._pool(index, health=health)
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _bad_payload([1, 2, 3])))
+            assert pool.drain()
+            assert health.bad_blocks_reported == 3
+            # A noisy-but-alive pod stays routable: revocation is about
+            # blocks, never liveness.
+            assert health.is_routable("pod-1")
+        finally:
+            pool.shutdown()
+
+
+class TestShardedRevocation:
+    def test_sharded_pool_revokes_across_shards(self):
+        from llm_d_kv_cache_manager_tpu.kvcache.sharding import (
+            ShardedEventsPool,
+            ShardedEventsPoolConfig,
+            ShardedIndex,
+        )
+
+        sharded = ShardedIndex([InMemoryIndex() for _ in range(3)], vnodes=8)
+        hashes = list(range(20))
+        sharded.add([Key(MODEL, h) for h in hashes], [PodEntry("pod-1", DeviceTier.TPU_HBM)])
+        calls = []
+        pool = ShardedEventsPool(
+            sharded,
+            ShardedEventsPoolConfig(dispatchers=2),
+            on_bad_block=lambda pod, hs, m: calls.append((pod, list(hs), m)),
+        )
+        pool.start()
+        try:
+            pool.add_task(Message("t", "pod-1", MODEL, _bad_payload(hashes)))
+            assert pool.drain()
+            got = sharded.lookup([Key(MODEL, h) for h in hashes], set())
+            assert all(got.get(Key(MODEL, h), []) == [] for h in hashes)
+            assert calls and calls[0][0] == "pod-1"
+        finally:
+            pool.shutdown()
+
+
+class TestBadBlockWire:
+    def test_round_trip(self):
+        ev = decode_event_batch(_bad_payload([1, 2], pod="p", medium="remote")).events[0]
+        assert isinstance(ev, BadBlock)
+        assert ev.block_hashes == [1, 2]
+        assert ev.pod == "p" and ev.medium == "remote"
+
+    def test_minimal_form_trailing_fields_elided(self):
+        import msgpack
+
+        payload = _bad_payload([5])
+        assert payload == msgpack.packb(
+            [0.0, [["BadBlock", [5]]]], use_bin_type=True
+        )
+        ev = decode_event_batch(payload).events[0]
+        assert ev.pod == "" and ev.medium is None
+
+
+# -- knobs-off parity pins ----------------------------------------------------
+class TestKnobsOffParity:
+    def test_engine_defaults_off(self):
+        eng = _engine(total_pages=12)
+        assert EngineConfig.__dataclass_fields__["kv_integrity"].default is False
+        assert eng.integrity is None
+        assert eng.block_manager._integrity is None
+        assert eng.block_manager._host_verify is None
+
+    def test_no_digests_on_wire_when_off(self):
+        eng = _engine(total_pages=64)
+        eng.add_request(_prompt(2, 16), SamplingParams(max_new_tokens=1))
+        eng.run_until_complete()
+        hashes = eng.block_manager.token_db.prefix_hashes(_prompt(2, 16))
+        blocks = eng.export_kv_blocks(hashes)
+        assert blocks and all(b.digest is None for b in blocks)
+        # Encoded block rows stay at the legacy arity — not a byte moves.
+        import msgpack
+
+        raw = msgpack.unpackb(
+            protocol.encode_response(blocks, True), use_list=True
+        )
+        assert all(len(row) <= 11 for row in raw[2])
+
+    def test_store_stats_keys_pinned_when_off(self):
+        eng = _engine(total_pages=12, remote_tier=True)
+        store = _store(eng)  # integrity=None rides the engine's None
+        assert set(store.stats) == {"accepted", "rejected", "evicted", "served"}
+
+    def test_outputs_identical_knob_on_vs_off(self):
+        outs = {}
+        for knob in (False, True):
+            eng = _engine(
+                total_pages=12,
+                host_pages=32,
+                host_tier_policy="always",
+                kv_integrity=knob,
+            )
+            got = []
+            for i in range(4):
+                seq = eng.add_request(
+                    _prompt(i, 16), SamplingParams(max_new_tokens=4)
+                )
+                eng.run_until_complete()
+                got.append(list(seq.generated_tokens))
+            outs[knob] = got
+        assert outs[False] == outs[True]
+
+    def test_exposition_gated(self):
+        pytest.importorskip("prometheus_client")
+        from llm_d_kv_cache_manager_tpu.server.serve import _ServingMetrics
+
+        off = _ServingMetrics(obs=True).exposition().decode()
+        assert "kvcache_integrity" not in off
+        on = _ServingMetrics(obs=True, integrity=True)
+        on.sync_integrity_stats(
+            {
+                "checks_ok": 2,
+                "checks_corrupt": 1,
+                "checks_unverified": 0,
+                "quarantined": 1,
+                "scrub_pages": 8,
+            }
+        )
+        text = on.exposition().decode()
+        assert 'kvcache_integrity_checks_total{outcome="ok"} 2.0' in text
+        assert 'kvcache_integrity_checks_total{outcome="corrupt"} 1.0' in text
+        assert "kvcache_integrity_quarantined_total 1.0" in text
+        assert "kvcache_integrity_scrub_pages_total 8.0" in text
+
+
+# -- concurrency hammer (runs under LOCKTRACE=1 in CI) ------------------------
+class TestDigestTableHammer:
+    def test_concurrent_record_check_quarantine(self):
+        bi = BlockIntegrity(table_cap=256)
+        stop = threading.Event()
+        errors = []
+
+        def writer(base):
+            i = 0
+            while not stop.is_set():
+                h = base + (i % 512)
+                bi.record(h, page_digest(b"k%d" % h, b"v"))
+                i += 1
+
+        def checker():
+            while not stop.is_set():
+                for h in range(0, 512, 7):
+                    bi.check(h, page_digest(b"k%d" % h, b"v"), "scrub")
+
+        def reaper():
+            while not stop.is_set():
+                for h in range(0, 512, 13):
+                    bi.quarantine(h, tier="host_dram")
+                    bi.is_quarantined(h)
+                bi.snapshot()
+
+        threads = [
+            threading.Thread(target=writer, args=(0,)),
+            threading.Thread(target=writer, args=(256,)),
+            threading.Thread(target=checker),
+            threading.Thread(target=reaper),
+        ]
+
+        def run():
+            try:
+                for t in threads:
+                    t.start()
+                stop.wait(0.5)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=10)
+
+        try:
+            run()
+        except Exception as e:  # pragma: no cover - hammer must not raise
+            errors.append(e)
+        assert not errors
+        assert len(bi) <= 256
+        snap = bi.snapshot()
+        assert snap["recorded"] >= snap["table_entries"]
