@@ -189,6 +189,16 @@ Env knobs (for ad-hoc runs; the driver uses defaults):
   BENCH_KV_INTEGRITY_PAGES=N  HBM pool size for the arm (default ~2
                        active sequences, so every warm prefix lives on
                        the spill→restore edge the digests guard)
+  BENCH_OBS_FED=1      fleet-federation overhead arm (ISSUE 20):
+                       headline is 4-pod FleetFederator.scrape() join
+                       latency (p50/p99 over 200 scrapes against fully
+                       loaded in-process payloads — three tiers, SLO
+                       burn, tenant slices, integrity, MRC/lifecycle/
+                       audit); the A/B is engine step p50 with a ~10 Hz
+                       background scraper reading LIVE engine state vs
+                       the bare engine. Acceptance: step p50 ratio
+                       <= 1.02x (the observation plane must not tax the
+                       hot path)
 """
 
 from __future__ import annotations
@@ -1412,7 +1422,7 @@ def run_fleet_arm(
                         transfer_endpoint=str(i),
                         capacity_blocks=pod_cap,
                         burn_rates=burn,
-                        mrc=debug_mrc_payload(mrc_est[i]),
+                        mrc=debug_mrc_payload(mrc_est[i])[1],
                         live_requests=[
                             rid
                             for rid, (pi, s) in live.items()
@@ -2287,6 +2297,180 @@ def lifecycle_overhead_ab(params, engine_cfg, workload, max_new_tokens):
     }
 
 
+def obs_fed_overhead_ab(params, engine_cfg, workload, max_new_tokens):
+    """ISSUE 20 overhead A/B: (a) the headline — 4-pod
+    ``FleetFederator.scrape()`` join latency (p50/p99 over ~200 scrapes
+    against in-process pods carrying realistic fully-loaded payloads:
+    three tiers, SLO burn, tenant slices, integrity, MRC/lifecycle/audit
+    surfaces); (b) per-engine-step wall time with a background scraper
+    thread hammering a federator whose fetch hooks read the LIVE engine
+    state during stepping, vs the bare engine on an identical stream.
+    The scraper runs at ~10 Hz — an order above any real deployment's
+    scrape cadence, and strictly pessimistic beyond that: it shares the
+    engine's process (and GIL), which a deployed scorer-side federator
+    never does. The bar: knobs-on step p50 within 2% of knobs-off."""
+    import threading
+
+    from llm_d_kv_cache_manager_tpu.obs.federation import FleetFederator
+    from llm_d_kv_cache_manager_tpu.server.engine import Engine
+    from llm_d_kv_cache_manager_tpu.server.sequence import SamplingParams
+
+    # -- headline: 4-pod snapshot join latency ---------------------------
+    def stub_fetch(seed):
+        # One pod's surfaces, every presence-gated block populated so the
+        # join pays its full price (legacy pods would be cheaper).
+        stats = {
+            "model": "bench/llama",
+            "total_pages": 1024,
+            "free_pages": 128 + seed,
+            "staged": 2,
+            "waiting": 3,
+            "running": 8,
+            "host": {"cached": 512, "host_pages": 2048},
+            "remote": {"store_cached": 256, "store_pages": 4096},
+            "prefill": {"cached_tokens": 40960 + seed, "computed_tokens": 8192},
+            "drain": {"draining": False},
+            "transfer": {
+                "breakers": {
+                    f"tcp://pod-{j}:5558": {"state": "closed"}
+                    for j in range(4)
+                }
+            },
+            "slo": {
+                "burn_rates": {
+                    "ttft": {"5m": 0.4, "1h": 0.2},
+                    "itl": {"5m": 0.1, "1h": 0.05},
+                }
+            },
+            "tenant_qos": {
+                "slo_burn": {"premium": {"ttft": {"5m": 0.3}}},
+                "cache": {
+                    "stats": {
+                        "premium": {"pages": 300, "share": 0.3},
+                        "batch": {"pages": 596, "share": 0.6},
+                    }
+                },
+            },
+            "integrity": {
+                "quarantined": 0,
+                "checks_corrupt": 0,
+                "bad_blocks_published": 0,
+            },
+            "flight": {
+                "triggers": 1,
+                "events_recorded": 2048,
+                "dumps_written": 1,
+            },
+        }
+        surfaces = {
+            "/stats": stats,
+            "/debug/mrc": {
+                "enabled": True,
+                "sampled": 4096,
+                "cold_fraction": 0.12,
+                "curve": [
+                    {"pages": c, "miss_ratio": round(1.0 - c / 1100, 4)}
+                    for c in range(64, 1025, 64)
+                ],
+            },
+            "/debug/lifecycle": {
+                "enabled": True,
+                "transitions_recorded": 10000 + seed,
+            },
+            "/debug/audit": {
+                "enabled": True,
+                "joined": 512,
+                "miss_causes": {"cold": 30, "evicted": 10, "stale_index": 2},
+            },
+            "/debug/staleness": None,
+        }
+        return lambda path: surfaces.get(path)
+
+    fed = FleetFederator(ring=256)
+    for i in range(4):
+        fed.register_pod(f"bench-p{i}", fetch=stub_fetch(i))
+    joins = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        fed.scrape()
+        joins.append(time.perf_counter() - t0)
+    join_p50 = float(np.percentile(joins, 50))
+    join_p99 = float(np.percentile(joins, 99))
+
+    # -- step A/B: bare engine vs engine + live-state scrape hammer ------
+    # The stream is repeated 3x: the instrument under test costs well
+    # under 1% duty cycle, so the median needs enough steps to resolve
+    # it from smoke-scale CPU jitter (a 36-step median wanders +-3%
+    # run-to-run on its own — see lifecycle_overhead_ab across records).
+    reqs = [tokens for _, _, tokens in workload[:24]] * 3
+    total_pages = engine_cfg.block_manager.total_pages
+    p50 = {}
+    scrapes_on = 0
+    for mode in ("off", "on"):
+        eng = Engine(engine_cfg, params=params)
+        stop = scraper = None
+        if mode == "on":
+            def live_stats():
+                # What a real in-process fetch hook reads mid-step: the
+                # live pool/scheduler counters, no locks the step path
+                # holds.
+                return {
+                    "model": "bench/llama",
+                    "total_pages": total_pages,
+                    "free_pages": eng.block_manager.num_free,
+                    "running": len(eng.scheduler.running),
+                    "prefill": dict(getattr(eng, "prefill_stats", {}) or {}),
+                    "drain": {"draining": False},
+                }
+
+            def live_fetch(path):
+                return live_stats() if path == "/stats" else None
+
+            live = FleetFederator(ring=256)
+            for i in range(4):
+                live.register_pod(f"live-p{i}", fetch=live_fetch)
+            stop = threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    live.scrape()
+                    stop.wait(0.1)
+
+            scraper = threading.Thread(
+                target=hammer, name="bench-fed-scraper", daemon=True
+            )
+            scraper.start()
+        steps = []
+        for tokens in reqs:
+            eng.add_request(tokens, SamplingParams(max_new_tokens=max_new_tokens))
+            while eng.has_work:
+                t0 = time.perf_counter()
+                eng.step()
+                steps.append(time.perf_counter() - t0)
+        if stop is not None:
+            stop.set()
+            scraper.join(timeout=5)
+            scrapes_on = live.snapshot()["scrapes"]
+        p50[mode] = float(np.median(steps))
+        n_steps = len(steps)
+        del eng
+        gc.collect()
+    return {
+        "requests": len(reqs),
+        "steps": n_steps,
+        "join_pods": 4,
+        "join_iters": len(joins),
+        "join_p50_s": round(join_p50, 6),
+        "join_p99_s": round(join_p99, 6),
+        "scrapes_during_on": scrapes_on,
+        "p50_step_off_s": round(p50["off"], 6),
+        "p50_step_on_s": round(p50["on"], 6),
+        "p50_on_over_off": (
+            round(p50["on"] / p50["off"], 4) if p50["off"] else None
+        ),
+    }
+
+
 def warmup(params, engine_cfg, prefix_len, suffix_len, vocab, max_new_tokens):
     """Compile every jit shape the measured runs will hit (cold prefill,
     warm suffix-only prefill, mixed batch, decode) on a scratch engine."""
@@ -3089,6 +3273,17 @@ def main() -> int:
             ),
         }
 
+    # -- Fleet-federation arm (ISSUE 20): scrape/join overhead A/B --------
+    # Headline: 4-pod FleetFederator.scrape() join latency against fully
+    # loaded in-process payloads. A/B: engine step p50 with a ~10 Hz
+    # background scraper reading LIVE engine state vs the bare engine —
+    # the observation plane must not tax the hot path (<= 2%).
+    obs_fed_detail = None
+    if os.environ.get("BENCH_OBS_FED", "0") == "1":
+        obs_fed_detail = obs_fed_overhead_ab(
+            params, engine_cfg, workload, max_new
+        )
+
     # Headline metrics are precise-vs-round_robin by definition: when a
     # BENCH_POLICIES subset omits either, the corresponding fields are
     # null rather than silently reporting another policy's numbers.
@@ -3142,6 +3337,7 @@ def main() -> int:
         "fleet_controller": fleet_detail,
         "tenant_qos": tenant_qos_detail,
         "kv_integrity": kv_integrity_detail,
+        "obs_fed": obs_fed_detail,
     }
     print(json.dumps(detail), file=sys.stderr)
 
@@ -3631,6 +3827,25 @@ def main() -> int:
                         ],
                     }
                     if kv_integrity_detail
+                    else None
+                ),
+                # Fleet-federation headline (ISSUE 20; null unless the
+                # BENCH_OBS_FED pass ran): the 4-pod snapshot join
+                # latency (p50/p99) and the step-p50 price of a live
+                # federator scraping the engine at ~100 Hz mid-decode.
+                "obs_fed": (
+                    {
+                        "join_pods": obs_fed_detail["join_pods"],
+                        "join_p50_s": obs_fed_detail["join_p50_s"],
+                        "join_p99_s": obs_fed_detail["join_p99_s"],
+                        "scrapes_during_on": obs_fed_detail[
+                            "scrapes_during_on"
+                        ],
+                        "step_p50_on_over_off": obs_fed_detail[
+                            "p50_on_over_off"
+                        ],
+                    }
+                    if obs_fed_detail
                     else None
                 ),
             }

@@ -89,7 +89,7 @@ class InProcessFleet:
                         else None
                     ),
                     mrc=(
-                        debug_mrc_payload(server.mrc)
+                        debug_mrc_payload(server.mrc)[1]
                         if server.mrc is not None
                         else None
                     ),

@@ -493,6 +493,48 @@ class FleetHealth:
                 for pod, st in self._pods.items()
             }
 
+    def scrape_views(self, pods: Sequence[str]) -> dict[str, dict]:
+        """Federator-facing liveness cut (``OBS_FED``): per registered
+        scrape target, is the pod worth polling at all? ONE locked walk
+        over the named pods — a fleet scrape must not pay N ``is_expired``
+        acquisitions against the ingestion workers. Unlike ``pod_views``
+        this covers pods the monitor has never heard from (``known:
+        False``, not expired — the observation-only default: a target the
+        operator registered but no event has named yet still gets
+        scraped). An ``expired`` pod is skipped by the scrape outright,
+        so a dead pod costs one skip, not one timeout per surface."""
+        ttl = self.config.pod_ttl_s
+        now = self._clock()
+        with self._mu:
+            out = {}
+            for pod in pods:
+                st = self._pods.get(pod)
+                if st is None:
+                    out[pod] = {
+                        "known": False,
+                        "expired": False,
+                        "suspect": False,
+                        "draining": False,
+                        "age_s": None,
+                    }
+                    continue
+                out[pod] = {
+                    "known": True,
+                    "expired": bool(
+                        st.swept
+                        or st.drained
+                        or (ttl > 0 and (now - st.last_seen) > ttl)
+                    ),
+                    "suspect": st.suspect,
+                    "draining": st.draining or st.drained,
+                    "age_s": (
+                        round(max(now - st.last_seen, 0.0), 3)
+                        if st.last_seen > 0
+                        else None
+                    ),
+                }
+            return out
+
     def snapshot(self) -> dict:
         """Counters + per-pod state for ``/stats``."""
         with self._mu:

@@ -116,6 +116,14 @@ integrity_checks = _NullMetric()
 integrity_quarantined = _NullMetric()
 integrity_scrub_pages = _NullMetric()
 integrity_bad_blocks = _NullMetric()
+# Fleet observability federation (ISSUE 20): the derived fleet health
+# rollup and the federator's own scrape accounting. Series appear only
+# when OBS_FED scrapes feed them — a knobs-off process never sets the
+# gauge or observes a scrape.
+fleet_health_score = _NullMetric()
+fleet_scrape_seconds = _NullMetric()
+fleet_scrape_errors = _NullMetric()
+fleet_pods_skipped = _NullMetric()
 
 # Internal shadow counters so the metrics beat can log without scraping.
 _shadow = {
@@ -158,6 +166,8 @@ def register(registry=None) -> None:
     global block_transitions, block_residency, reuse_distance
     global integrity_checks, integrity_quarantined
     global integrity_scrub_pages, integrity_bad_blocks
+    global fleet_health_score, fleet_scrape_seconds
+    global fleet_scrape_errors, fleet_pods_skipped
     with _lock:
         if _registered:
             return
@@ -410,7 +420,70 @@ def register(registry=None) -> None:
             "index; KV_INTEGRITY)",
             registry=registry,
         )
+        fleet_health_score = _prom.Gauge(
+            "kvcache_fleet_health_score",
+            "Derived fleet health rollup in [0, 1] from the last "
+            "federated scrape (OBS_FED): mean per-pod score — "
+            "unreachable/expired pods score 0, draining caps at 0.5, "
+            "burning SLOs / open breakers / near-full HBM / quarantines "
+            "deduct (see obs/federation.py); refreshed per scrape",
+            registry=registry,
+        )
+        fleet_scrape_seconds = _prom.Histogram(
+            "kvcache_fleet_scrape_seconds",
+            "Wall time of one federated fleet scrape-and-join across "
+            "all registered pods (OBS_FED)",
+            registry=registry,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        fleet_scrape_errors = _prom.Counter(
+            "kvcache_fleet_scrape_errors_total",
+            "Registered pods whose /stats fetch failed during a "
+            "federated scrape (OBS_FED) — expired pods are skipped, "
+            "not errored",
+            registry=registry,
+        )
+        fleet_pods_skipped = _prom.Counter(
+            "kvcache_fleet_scrape_pods_skipped_total",
+            "Registered pods skipped outright by a federated scrape "
+            "because FleetHealth reported them expired/swept/drained "
+            "(OBS_FED) — the dead-pod-costs-one-skip guarantee",
+            registry=registry,
+        )
         _registered = True
+
+
+def observe_score_latency(seconds: float, trace_id: Optional[str] = None) -> None:
+    """One scoring request's wall time. Under OBS_EXEMPLARS the caller
+    passes the observing request's trace_id, which rides as an
+    OpenMetrics exemplar on the bucket it lands in — a tail bucket then
+    resolves directly to ``/debug/traces?trace=<id>``. Exemplars render
+    only in the OpenMetrics exposition (the classic text format drops
+    them), so the scorer switches formats under the same knob."""
+    if trace_id:
+        score_latency.observe(seconds, exemplar={"trace_id": trace_id})
+    else:
+        score_latency.observe(seconds)
+
+
+def observe_fleet_scrape(
+    scrape_s: float,
+    errors: int = 0,
+    skipped: int = 0,
+    health: Optional[float] = None,
+) -> None:
+    """Mirror one federated fleet scrape into the OBS_FED families
+    (scrape-driven, like the occupancy gauges): join wall time, per-scrape
+    fetch errors and dead-pod skips, and the derived health rollup."""
+    bump("fleet_scrapes")
+    fleet_scrape_seconds.observe(scrape_s)
+    if errors:
+        fleet_scrape_errors.inc(errors)
+    if skipped:
+        fleet_pods_skipped.inc(skipped)
+    if health is not None:
+        fleet_health_score.set(health)
 
 
 def observe_route_decision(action: str) -> None:

@@ -14,6 +14,12 @@ from .audit import (  # noqa: F401
     debug_audit_payload,
     debug_staleness_payload,
 )
+from .federation import (  # noqa: F401
+    SCRAPE_SURFACES,
+    FederatedPod,
+    FleetFederator,
+    debug_fleet_payload,
+)
 from .slo import (  # noqa: F401
     SLObjective,
     SLORecorder,

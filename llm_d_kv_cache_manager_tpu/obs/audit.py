@@ -616,7 +616,12 @@ class RouteAuditor:
             rows = [r for r in rows if r.request_id == request_id]
         if trace_id is not None:
             rows = [r for r in rows if r.trace_id == trace_id]
-        return [r.to_dict() for r in rows[-max(limit, 0):]]
+        # The Tracer limit contract: limit <= 0 returns nothing. (The old
+        # `rows[-max(limit, 0):]` slice returned EVERYTHING at limit=0 —
+        # the one debug surface that inverted the contract.)
+        if limit <= 0:
+            return []
+        return [r.to_dict() for r in rows[-limit:]]
 
     def snapshot(self) -> dict:
         with self._mu:
@@ -642,12 +647,43 @@ class RouteAuditor:
             }
 
 
-def debug_staleness_payload(tracker: Optional[StalenessTracker]) -> dict:
+def _cap_per_pod_event(detail: dict, limit: int) -> dict:
+    """Apply the Tracer limit contract to a ``detail()`` payload: cap the
+    per-(pod, event) histogram rows (the only unbounded-in-fleet-size
+    part) at ``limit``, recursing into per-shard details for the merged
+    view. Sorted keys so the same limit always keeps the same rows."""
+    out = dict(detail)
+    if "per_pod_event" in out:
+        rows = out["per_pod_event"]
+        out["per_pod_event"] = {
+            k: rows[k] for k in sorted(rows)[: max(limit, 0)]
+        }
+    if "shards" in out:
+        out["shards"] = {
+            shard: _cap_per_pod_event(d, limit)
+            for shard, d in out["shards"].items()
+        }
+    return out
+
+
+def debug_staleness_payload(
+    tracker: Optional[StalenessTracker], query
+) -> tuple[int, dict]:
     """``GET /debug/staleness`` body (the endpoint is always routable;
-    with the knob off it reports itself disabled, like /debug/traces)."""
+    with the knob off it reports itself disabled, like /debug/traces).
+    ``?limit=`` caps the per-(pod, event) histogram rows with the Tracer
+    contract (``limit <= 0`` returns nothing); tolerant 400 on a bad
+    limit."""
     if tracker is None:
-        return {"enabled": False}
-    return {"enabled": True, **tracker.detail()}
+        return 200, {"enabled": False}
+    try:
+        limit = int(query.get("limit", "50"))
+    except ValueError:
+        return 400, {"error": "invalid limit (want an int)"}
+    return 200, {
+        "enabled": True,
+        **_cap_per_pod_event(tracker.detail(), limit),
+    }
 
 
 def debug_audit_payload(

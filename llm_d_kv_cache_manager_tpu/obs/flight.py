@@ -207,13 +207,29 @@ class FlightRecorder:
             }
 
 
-def debug_flight_payload(recorder: Optional[FlightRecorder]) -> dict:
+def debug_flight_payload(
+    recorder: Optional[FlightRecorder], query=None
+) -> tuple[int, dict]:
     """``GET /debug/flight`` body: recorder counters plus the latest
-    trigger's timeline; disabled-shaped when the knob is off."""
+    trigger's timeline; disabled-shaped when the knob is off. ``?limit=``
+    caps timeline entries with the Tracer contract (``limit <= 0``
+    returns nothing); tolerant 400 on a bad limit. ``query=None`` keeps
+    in-process callers limit-free."""
     if recorder is None:
-        return {"enabled": False}
-    return {
+        return 200, {"enabled": False}
+    limit = None
+    if query is not None:
+        try:
+            limit = int(query.get("limit", "1000"))
+        except ValueError:
+            return 400, {"error": "invalid limit (want an int)"}
+    timeline = recorder.timeline()
+    if limit is not None and timeline is not None:
+        timeline = dict(timeline)
+        entries = timeline.get("entries", [])
+        timeline["entries"] = entries[-limit:] if limit > 0 else []
+    return 200, {
         "enabled": True,
         **recorder.snapshot(),
-        "timeline": recorder.timeline(),
+        "timeline": timeline,
     }

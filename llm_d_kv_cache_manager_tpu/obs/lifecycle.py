@@ -530,12 +530,23 @@ def debug_lifecycle_payload(
 def debug_mrc_payload(
     mrc: Optional[ReuseDistanceEstimator],
     tier_capacities: Optional[dict] = None,
-) -> dict:
+    query=None,
+) -> tuple[int, dict]:
     """``GET /debug/mrc`` body: the miss-ratio curve plus per-tier
     predicted hit rates at the ladder's cumulative capacities
-    (``tier_capacities``: name -> blocks, e.g. HBM / HBM+host / fleet)."""
+    (``tier_capacities``: name -> blocks, e.g. HBM / HBM+host / fleet).
+    ``?limit=`` caps curve rows with the Tracer contract (``limit <= 0``
+    returns nothing); tolerant 400 on a bad limit. ``query=None`` keeps
+    in-process callers (the fleet controller, the federator's join)
+    limit-free."""
     if mrc is None:
-        return {"enabled": False}
+        return 200, {"enabled": False}
+    limit = None
+    if query is not None:
+        try:
+            limit = int(query.get("limit", str(len(REUSE_DISTANCE_BUCKETS))))
+        except ValueError:
+            return 400, {"error": "invalid limit (want an int)"}
     tiers = {}
     for name, cap in (tier_capacities or {}).items():
         hit = mrc.predicted_hit_rate(int(cap))
@@ -543,9 +554,13 @@ def debug_mrc_payload(
             "capacity_blocks": int(cap),
             "predicted_hit_rate": round(hit, 4) if hit is not None else None,
         }
-    return {
+    curve = mrc.mrc()
+    if limit is not None:
+        curve = curve[: max(limit, 0)]
+        tiers = {k: tiers[k] for k in sorted(tiers)[: max(limit, 0)]}
+    return 200, {
         "enabled": True,
-        "curve": mrc.mrc(),
+        "curve": curve,
         "tiers": tiers,
         **mrc.snapshot(),
     }

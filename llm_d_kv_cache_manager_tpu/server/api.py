@@ -145,6 +145,25 @@ class ServiceConfig:
     #: queue/rate signals as unknown (conservative defaults). 0 = the
     #: staleness gate is off (signals trusted as supplied)
     route_predict_heartbeat_s: float = 0.0
+    #: fleet observability federation (ISSUE 20): attach a scorer-side
+    #: ``FleetFederator`` that polls every registered pod's ``/stats`` +
+    #: ``/debug/*`` surfaces (in-process hooks or HTTP) and serves the
+    #: joined, causally-stamped fleet snapshot at ``GET /debug/fleet``
+    #: plus a ``fed`` /stats block and the ``kvcache_fleet_*`` scrape
+    #: families. Off (default) = no federator attached, bit-identical
+    #: ``/stats`` keys, exposition, and wire bytes.
+    obs_fed: bool = False
+    #: federation delta-ring depth (scrapes of history) for /debug/fleet
+    obs_fed_ring: int = 256
+    #: per-pod HTTP fetch timeout for federated scrapes, seconds (the
+    #: in-process hook path never times out)
+    obs_fed_timeout_s: float = 2.0
+    #: OpenMetrics trace exemplars (ISSUE 20): the scorer's score-latency
+    #: histogram attaches the observing request's trace_id per bucket and
+    #: ``/metrics`` switches to the OpenMetrics exposition (the classic
+    #: text format drops exemplars). Off (default) = classic exposition,
+    #: bit-identical bytes.
+    obs_exemplars: bool = False
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
@@ -179,6 +198,12 @@ class ServiceConfig:
             route_predict_heartbeat_s=float(
                 env.get("ROUTE_PREDICT_HEARTBEAT_S", "0")
             ),
+            obs_fed=env.get("OBS_FED", "").strip().lower()
+            in ("1", "true", "yes", "on"),
+            obs_fed_ring=int(env.get("OBS_FED_RING", "256")),
+            obs_fed_timeout_s=float(env.get("OBS_FED_TIMEOUT_S", "2.0")),
+            obs_exemplars=env.get("OBS_EXEMPLARS", "").strip().lower()
+            in ("1", "true", "yes", "on"),
         )
 
 
@@ -421,6 +446,21 @@ class ScoringService:
             max_spans=cfg.obs_trace_buffer,
             service="scorer",
         )
+        #: fleet observability federation (OBS_FED): the scorer-side
+        #: scrape-and-join over every registered pod's surfaces. None
+        #: (default) = no federator, /debug/fleet answers disabled-shaped,
+        #: bit-identical /stats keys and exposition.
+        self.federator = None
+        if cfg.obs_fed:
+            from ..obs.federation import FleetFederator
+
+            self.federator = FleetFederator(
+                health=self.fleet_health,
+                staleness=self.staleness,
+                ring=cfg.obs_fed_ring,
+                timeout_s=cfg.obs_fed_timeout_s,
+                on_scrape=collector.observe_fleet_scrape,
+            )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -641,7 +681,16 @@ class ScoringService:
                 collector.scorer_errors.inc()
                 span.set_attr("error", type(exc).__name__)
                 return headers, None, str(exc), None
-            collector.score_latency.observe(time.perf_counter() - t0)
+            collector.observe_score_latency(
+                time.perf_counter() - t0,
+                # OBS_EXEMPLARS: the observing request's trace id rides
+                # the histogram bucket as an OpenMetrics exemplar.
+                trace_id=(
+                    span.context.trace_id
+                    if self.config.obs_exemplars and span.context is not None
+                    else None
+                ),
+            )
             span.set_attr("pods_scored", len(scores))
             if self.config.obs_metrics:
                 collector.set_scoreboard_size(len(scores))
@@ -827,6 +876,17 @@ class ScoringService:
         try:
             import prometheus_client
 
+            if self.config.obs_exemplars:
+                # Exemplars render only in the OpenMetrics exposition —
+                # the classic text format silently drops them. aiohttp's
+                # content_type= rejects parameterized types, so the full
+                # header rides the headers dict.
+                from prometheus_client.openmetrics import exposition as om
+
+                return web.Response(
+                    body=om.generate_latest(prometheus_client.REGISTRY),
+                    headers={"Content-Type": om.CONTENT_TYPE_LATEST},
+                )
             data = prometheus_client.generate_latest()
             return web.Response(
                 body=data, content_type="text/plain", charset="utf-8"
@@ -863,18 +923,25 @@ class ScoringService:
             "index": collector.snapshot(),
         }
         # New blocks only behind their knobs: the knobs-off /stats payload
-        # keeps its legacy field set bit-identical.
+        # keeps its legacy field set bit-identical. The staleness tracker
+        # is snapshotted ONCE and shared by the obs + staleness blocks —
+        # two separate reads (the pre-ISSUE-20 shape) could tear: an event
+        # applied between them made the obs block's events-behind disagree
+        # with the staleness block's in the same response.
+        stale_snap = (
+            self.staleness.snapshot() if self.staleness is not None else None
+        )
         if self.config.obs_metrics:
             payload["obs"] = {
                 "scoreboard_size": self._last_scoreboard_size,
                 "events_behind": (
-                    self.staleness.events_behind()
-                    if self.staleness is not None
+                    stale_snap["events_behind"]
+                    if stale_snap is not None
                     else {}
                 ),
             }
-        if self.staleness is not None and self.config.obs_audit:
-            payload["staleness"] = self.staleness.snapshot()
+        if stale_snap is not None and self.config.obs_audit:
+            payload["staleness"] = stale_snap
         if self.lifecycle is not None:
             # Gated on OBS_LIFECYCLE: the knobs-off /stats payload keeps
             # its legacy field set bit-identical.
@@ -897,6 +964,10 @@ class ScoringService:
                 "misroutes": self.events_pool.misroute_snapshot(),
                 "per_shard_index": self._last_shard_sizes,
             }
+        if self.federator is not None:
+            # Gated on OBS_FED: compact scrape accounting only — the full
+            # fleet join is /debug/fleet's job.
+            payload["fed"] = self.federator.snapshot()
         return web.json_response(payload)
 
     async def handle_debug_traces(self, request: web.Request) -> web.Response:
@@ -911,7 +982,10 @@ class ScoringService:
         /debug/traces) until OBS_AUDIT/OBS_METRICS attaches the tracker."""
         from ..obs.audit import debug_staleness_payload
 
-        return web.json_response(debug_staleness_payload(self.staleness))
+        status, payload = debug_staleness_payload(
+            self.staleness, request.query
+        )
+        return web.json_response(payload, status=status)
 
     async def handle_debug_audit(self, request: web.Request) -> web.Response:
         """Recent joined predicted-vs-realized audits, filterable by
@@ -972,7 +1046,30 @@ class ScoringService:
                 )
             self.report_mrc(pod, mrc)
             return web.json_response({"ok": True})
-        return web.json_response(self.fleet_mrc())
+        # The Tracer limit contract on the GET side: ?limit= caps fleet
+        # curve rows (limit<=0 returns nothing), tolerant 400 on junk.
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError:
+            return web.json_response(
+                {"error": "invalid limit (want an int)"}, status=400
+            )
+        payload = self.fleet_mrc()
+        if "curve" in payload:
+            payload["curve"] = payload["curve"][: max(limit, 0)]
+        return web.json_response(payload)
+
+    async def handle_debug_fleet(self, request: web.Request) -> web.Response:
+        """The federated fleet snapshot: a FRESH scrape-and-join over
+        every registered pod (pushed to an executor — the HTTP fetch path
+        blocks) plus the delta-ring history; disabled-shaped until
+        OBS_FED attaches the federator."""
+        from ..obs.federation import debug_fleet_payload
+
+        status, payload = await asyncio.get_running_loop().run_in_executor(
+            None, debug_fleet_payload, self.federator, request.query
+        )
+        return web.json_response(payload, status=status)
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -987,6 +1084,7 @@ class ScoringService:
         app.router.add_get("/debug/lifecycle", self.handle_debug_lifecycle)
         app.router.add_get("/debug/mrc", self.handle_debug_mrc)
         app.router.add_post("/debug/mrc", self.handle_debug_mrc)
+        app.router.add_get("/debug/fleet", self.handle_debug_fleet)
         return app
 
 

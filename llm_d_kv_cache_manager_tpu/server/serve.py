@@ -116,6 +116,7 @@ class _ServingMetrics:
         lifecycle: bool = False,
         tenant_qos: bool = False,
         integrity: bool = False,
+        exemplars: bool = False,
     ):
         """``obs``: build the PR-5 latency-decomposition histograms and
         engine-step telemetry series (``OBS_METRICS``). ``lifecycle``:
@@ -136,6 +137,12 @@ class _ServingMetrics:
         self._lifecycle = bool(lifecycle)
         self._tenant_qos = bool(tenant_qos)
         self._integrity = bool(integrity)
+        # OBS_EXEMPLARS (ISSUE 20): latency histograms attach the
+        # observing request's trace_id per bucket, and exposition()
+        # switches to the OpenMetrics format (the classic text format
+        # drops exemplars) — a tail bucket then resolves directly to
+        # /debug/traces?trace=<id>.
+        self._exemplars = bool(exemplars)
         try:
             import prometheus_client as prom
         except ImportError:  # pragma: no cover
@@ -492,16 +499,24 @@ class _ServingMetrics:
             tenant=tenant, objective=objective, window=window
         ).set(rate)
 
-    def observe_pull(self, seconds: float, outcome: str) -> None:
+    def observe_pull(
+        self, seconds: float, outcome: str, trace_id: Optional[str] = None
+    ) -> None:
         """One ``pull_prefix`` attempt: outcome ok (imported >= 1 block),
         empty (nothing to pull — no hashes, or peer had no warm blocks),
         skipped (never attempted: deadline budget exhausted or the pod is
         shutting down — the overload signal, kept distinct from empty),
         failed (fetch/import error, fell back to cold), or canceled (the
-        sequence died while an async fetch was in flight)."""
+        sequence died while an async fetch was in flight). Under
+        OBS_EXEMPLARS the pulling request's trace_id rides the bucket as
+        an OpenMetrics exemplar."""
         if self._prom is None or not self._obs:
             return
-        self.transfer_pull.labels(outcome=outcome).observe(seconds)
+        hist = self.transfer_pull.labels(outcome=outcome)
+        if self._exemplars and trace_id:
+            hist.observe(seconds, exemplar={"trace_id": trace_id})
+        else:
+            hist.observe(seconds)
 
     def observe_pull_overlap(self, hidden_s: float, exposed_s: float) -> None:
         """One async pull's wall-time split: ``hidden`` = before the
@@ -589,8 +604,19 @@ class _ServingMetrics:
             return
         outcome, finish = self.request_labels(seq)
         lab = {"outcome": outcome, "finish": finish}
+        # OBS_EXEMPLARS: the finishing request's trace id (still attached
+        # here — spans are detached later, in _emit_request_spans) rides
+        # the TTFT/ITL buckets it lands in.
+        exemplar = None
+        if self._exemplars and seq.trace_span is not None:
+            ctx = getattr(seq.trace_span, "context", None)
+            if ctx is not None:
+                exemplar = {"trace_id": ctx.trace_id}
         if seq.ttft is not None:
-            self.req_ttft.labels(**lab).observe(seq.ttft)
+            if exemplar is not None:
+                self.req_ttft.labels(**lab).observe(seq.ttft, exemplar=exemplar)
+            else:
+                self.req_ttft.labels(**lab).observe(seq.ttft)
         if seq.prefill_start_time is not None:
             self.req_queue.labels(**lab).observe(
                 max(seq.prefill_start_time - seq.arrival_time, 0.0)
@@ -600,7 +626,12 @@ class _ServingMetrics:
                 max(seq.finish_time - seq.arrival_time, 0.0)
             )
             if seq.mean_itl is not None:
-                self.req_itl.labels(**lab).observe(seq.mean_itl)
+                if exemplar is not None:
+                    self.req_itl.labels(**lab).observe(
+                        seq.mean_itl, exemplar=exemplar
+                    )
+                else:
+                    self.req_itl.labels(**lab).observe(seq.mean_itl)
 
     def sync_lifecycle_stats(self, stats: dict) -> None:
         """Mirror the engine's monotone lifecycle counters (deadline sheds/
@@ -685,7 +716,23 @@ class _ServingMetrics:
     def exposition(self) -> Optional[bytes]:
         if self._prom is None:
             return None
+        if self._exemplars:
+            # Exemplars render only in the OpenMetrics exposition — the
+            # classic text format silently drops them.
+            from prometheus_client.openmetrics import exposition as om
+
+            return om.generate_latest(self.registry)
         return self._prom.generate_latest(self.registry)
+
+    def exposition_content_type(self) -> str:
+        """The Content-Type matching ``exposition()``'s format (the
+        OpenMetrics one is parameterized — callers must set it via a
+        headers dict; aiohttp's ``content_type=`` rejects parameters)."""
+        if self._exemplars:
+            from prometheus_client.openmetrics import exposition as om
+
+            return om.CONTENT_TYPE_LATEST
+        return "text/plain"
 
 
 def _env_bool(name: str, default: str) -> bool:
@@ -802,6 +849,12 @@ class PodServerConfig:
     #: engine-step phase timing, batch-occupancy / free-page / loop-lag
     #: gauges on /metrics, and an ``obs`` block on /stats.
     obs_metrics: bool = False
+    #: OpenMetrics trace exemplars (ISSUE 20): the OBS_METRICS latency
+    #: histograms (TTFT/ITL/pull) attach the observing request's trace_id
+    #: per bucket and /metrics switches to the OpenMetrics exposition —
+    #: a tail bucket resolves directly to ``/debug/traces?trace=<id>``.
+    #: Off (default) = classic exposition, bit-identical bytes.
+    obs_exemplars: bool = False
     #: directory for ``POST /debug/profile`` jax.profiler traces; unset =
     #: the endpoint is disabled.
     obs_profile_dir: Optional[str] = None
@@ -963,6 +1016,7 @@ class PodServerConfig:
             os.environ.get("OBS_TRACE_BUFFER", cfg.obs_trace_buffer)
         )
         cfg.obs_metrics = _env_bool("OBS_METRICS", "0")
+        cfg.obs_exemplars = _env_bool("OBS_EXEMPLARS", "0")
         cfg.obs_profile_dir = os.environ.get("OBS_PROFILE_DIR") or None
         cfg.obs_audit = _env_bool("OBS_AUDIT", "0")
         cfg.obs_slo = os.environ.get("OBS_SLO", "")
@@ -1182,6 +1236,7 @@ class PodServer:
             lifecycle=self.config.obs_lifecycle,
             tenant_qos=bool(self.config.tenant_qos.strip()),
             integrity=self.config.kv_integrity,
+            exemplars=self.config.obs_exemplars,
         )
         # -- KV-block integrity plane (ISSUE 19; off = None, no hooks) -----
         #: the engine's ``BlockIntegrity`` (digest table + quarantine set),
@@ -2890,7 +2945,13 @@ class PodServer:
             span.set_attr("imported_blocks", imported)
             span.set_attr("overlap", round(hidden, 6))
             span.end()
-            self.metrics.observe_pull(t1 - t0, outcome)
+            self.metrics.observe_pull(
+                t1 - t0,
+                outcome,
+                trace_id=(
+                    span.context.trace_id if span.context is not None else None
+                ),
+            )
             self.metrics.observe_pull_overlap(hidden, exposed)
             self._finish_async_pull(seq, job)
 
@@ -2925,7 +2986,13 @@ class PodServer:
             span.set_attr("outcome", outcome)
             span.set_attr("imported_blocks", n)
             span.end()
-            self.metrics.observe_pull(time.monotonic() - t_pull, outcome)
+            self.metrics.observe_pull(
+                time.monotonic() - t_pull,
+                outcome,
+                trace_id=(
+                    span.context.trace_id if span.context is not None else None
+                ),
+            )
             return n
 
         fetch_timeout: Optional[float] = None  # None = client's configured
@@ -3452,6 +3519,14 @@ class PodServer:
                 tenant_qos_snap = (
                     self.qos.snapshot() if self.qos is not None else None
                 )
+                # Fleet-controller counters in the SAME cut (ISSUE 20
+                # consistency fix): the fleet block below used to
+                # re-acquire _mu, so a migration landing between the two
+                # holds could pair fresh migration counts with stale
+                # queue/pull state in one scrape.
+                migrations_out = self.migrations_out
+                migrations_in = self.migrations_in
+                migration_fallbacks = self.migration_fallbacks
             payload = {
                 "pod": self.config.pod_identifier,
                 "model": self.config.model_name,
@@ -3620,11 +3695,8 @@ class PodServer:
                 payload["tenant_qos"] = tenant_qos_snap
             if self.config.fleet_controller:
                 # Fleet block only with the knob on: the knobs-off
-                # /stats payload stays bit-identical.
-                with self._mu:
-                    migrations_out = self.migrations_out
-                    migrations_in = self.migrations_in
-                    migration_fallbacks = self.migration_fallbacks
+                # /stats payload stays bit-identical. Counters come from
+                # the single locked cut at the top of this handler.
                 payload["fleet"] = {
                     "migrations_out": migrations_out,
                     "migrations_in": migrations_in,
@@ -3656,7 +3728,12 @@ class PodServer:
                 return web.json_response(
                     {"error": "prometheus_client not installed"}, status=501
                 )
-            return web.Response(body=body, content_type="text/plain")
+            return web.Response(
+                body=body,
+                headers={
+                    "Content-Type": self.metrics.exposition_content_type()
+                },
+            )
 
         async def debug_traces(request: web.Request) -> web.Response:
             """Finished traces from the bounded ring, filterable by
@@ -3691,15 +3768,22 @@ class PodServer:
                 caps["tpu_hbm+host_dram"] = (
                     bm_cfg.total_pages - 1 + bm_cfg.host_pages
                 )
-            payload = debug_mrc_payload(self.mrc, tier_capacities=caps)
+            status, payload = debug_mrc_payload(
+                self.mrc, tier_capacities=caps, query=request.query
+            )
+            if status != 200:
+                return web.json_response(payload, status=status)
             if self.qos is not None:
                 # Per-tenant MRC slices (TENANT_QOS + OBS_LIFECYCLE):
                 # each tenant's own reuse-distance curve — the "how much
                 # cache does THIS tenant's hit rate actually need" input
                 # for cache_share sizing. Key presence only with the
-                # knob on keeps the legacy payload bit-identical.
+                # knob on keeps the legacy payload bit-identical. The
+                # slices share the request's limit via the same helper.
                 payload["tenants"] = {
-                    t: debug_mrc_payload(est, tier_capacities=caps)
+                    t: debug_mrc_payload(
+                        est, tier_capacities=caps, query=request.query
+                    )[1]
                     for t, est in sorted(
                         dict(self.engine.block_manager._tenant_mrc).items()
                     )
@@ -3711,7 +3795,10 @@ class PodServer:
             (causally ordered). Disabled-shaped until OBS_FLIGHT."""
             from ..obs.flight import debug_flight_payload
 
-            return web.json_response(debug_flight_payload(self.flight))
+            status, payload = debug_flight_payload(
+                self.flight, query=request.query
+            )
+            return web.json_response(payload, status=status)
 
         async def debug_profile(request: web.Request) -> web.Response:
             """Capture a jax.profiler trace of the live engine for

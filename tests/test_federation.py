@@ -1,0 +1,1059 @@
+"""Fleet observability federation suite (ISSUE 20 acceptance).
+
+- **FleetFederator unit**: registration, the dead-pod skip gate, error
+  rows, the deterministic health-score formula, seq monotonicity, the
+  bounded delta ring and its limit contract.
+- **4-pod joined-vs-direct equality** (the acceptance pin): one
+  ``/debug/fleet`` scrape over four HTTP-registered pods returns per-pod
+  tier occupancy, SLO burn, and staleness that agree with each pod's own
+  ``/stats`` surface fetched directly.
+- **Trace exemplars** (``OBS_EXEMPLARS``): a forced-tail request's
+  ``kvcache_request_ttft_seconds`` bucket carries an OpenMetrics
+  exemplar whose trace_id resolves in ``/debug/traces``; knob off = no
+  exemplar syntax anywhere in the exposition bytes and the classic
+  content type.
+- **Satellite 1**: the pod ``/stats`` scrape assembles every gated block
+  from ONE locked cut (counting-lock pin + torn-read hammer on the
+  fleet-migration counters).
+- **Satellite 2**: every ``/debug/*`` GET on both APIs honors the
+  Tracer limit contract (``limit<=0`` → nothing, junk → 400) and
+  answers ``application/json``.
+- **Satellite 3**: two-way exposition sweep — every family in the
+  docs/observability.md catalog is actually emitted under its knob, and
+  nothing emitted is undocumented.
+- **kvtop**: renders against both an in-process federator and a scorer
+  URL; disabled banner when the knob is off.
+"""
+
+import asyncio
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from conftest import free_tcp_port
+from llm_d_kv_cache_manager_tpu.kvcache.metrics import collector
+from llm_d_kv_cache_manager_tpu.models import TINY_LLAMA
+from llm_d_kv_cache_manager_tpu.obs.federation import (
+    SCRAPE_SURFACES,
+    FleetFederator,
+    debug_fleet_payload,
+)
+from llm_d_kv_cache_manager_tpu.server import (
+    BlockManagerConfig,
+    EngineConfig,
+    SchedulerConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.api import (
+    ScoringService,
+    ServiceConfig,
+)
+from llm_d_kv_cache_manager_tpu.server.serve import (
+    PodServer,
+    PodServerConfig,
+    _ServingMetrics,
+)
+
+PS = 4
+MODEL = "tiny-llama"
+
+
+def _engine_config(total_pages=64):
+    return EngineConfig(
+        model=TINY_LLAMA,
+        block_manager=BlockManagerConfig(total_pages=total_pages, page_size=PS),
+        scheduler=SchedulerConfig(max_prefill_batch=4),
+        max_model_len=64,
+        decode_batch_size=4,
+        prefill_bucket=8,
+        interpret=True,
+    )
+
+
+def _pod_config(pod_id, **kw):
+    return PodServerConfig(
+        model_name=MODEL,
+        pod_identifier=pod_id,
+        publish_events=False,
+        engine=_engine_config(total_pages=kw.pop("total_pages", 64)),
+        **kw,
+    )
+
+
+def _prompt(seed, n):
+    return list(
+        map(int, np.random.default_rng(seed).integers(0, TINY_LLAMA.vocab_size, n))
+    )
+
+
+def _stats(pod="p0", total=64, free=48, **extra):
+    """A minimal legacy-shaped pod /stats payload for stub fetch hooks."""
+    return {
+        "pod": pod,
+        "model": MODEL,
+        "staged": 0,
+        "waiting": 1,
+        "running": 2,
+        "free_pages": free,
+        "total_pages": total,
+        "prefill": {"requests": 3, "cached_prompt_tokens": 8},
+        "transfer": {"breakers": {}},
+        "drain": {"draining": False},
+        **extra,
+    }
+
+
+def _stub_fetch(stats, **surfaces):
+    """fetch hook serving /stats plus any explicit debug surfaces."""
+
+    def fetch(path):
+        if path == "/stats":
+            return stats
+        return surfaces.get(path.rsplit("/", 1)[-1])
+
+    return fetch
+
+
+class _StubHealth:
+    """FleetHealth stand-in: scrape_views from a fixed expired set."""
+
+    def __init__(self, expired=()):
+        self.expired = set(expired)
+
+    def scrape_views(self, pods):
+        return {
+            p: {
+                "known": True,
+                "expired": p in self.expired,
+                "suspect": False,
+                "draining": False,
+                "age_s": 0.0,
+            }
+            for p in pods
+        }
+
+
+# ---------------------------------------------------------------------------
+# FleetFederator unit
+# ---------------------------------------------------------------------------
+
+
+class TestFleetFederatorUnit:
+    def test_registration_contract(self):
+        fed = FleetFederator()
+        with pytest.raises(ValueError):
+            fed.register_pod("p0")
+        fed.register_pod("p1", fetch=_stub_fetch(_stats("p1")))
+        fed.register_pod("p0", url="http://localhost:1")
+        assert fed.pods() == ["p0", "p1"]
+        fed.drop_pod("p0")
+        fed.drop_pod("p0")  # idempotent
+        assert fed.pods() == ["p1"]
+
+    def test_scrape_joins_tiers_queue_attribution(self):
+        fed = FleetFederator()
+        fed.register_pod(
+            "p0",
+            fetch=_stub_fetch(
+                _stats("p0", total=64, free=48,
+                       host={"cached": 5, "host_pages": 32})
+            ),
+        )
+        snap = fed.scrape()
+        row = snap["pods"]["p0"]
+        assert row["ok"] is True
+        assert row["tiers"]["tpu_hbm"] == {"used": 16, "total": 64, "fill": 0.25}
+        assert row["tiers"]["host_dram"]["used"] == 5
+        assert row["queue"] == {"staged": 0, "waiting": 1, "running": 2}
+        assert row["attribution"]["cached_prompt_tokens"] == 8
+        # Legacy pod (knobs off): no invented blocks.
+        for absent in ("slo_burn", "quarantine", "mrc", "flight"):
+            assert absent not in row
+        assert snap["fleet"] == {
+            "pods_ok": 1,
+            "pods_failed": 0,
+            "tiers": {
+                "host_dram": {"used": 5, "total": 32, "fill": 0.1562},
+                "tpu_hbm": {"used": 16, "total": 64, "fill": 0.25},
+            },
+            "health_score": 1.0,
+        }
+
+    def test_seq_monotone_and_ring_bounded(self):
+        fed = FleetFederator(ring=3)
+        fed.register_pod("p0", fetch=_stub_fetch(_stats()))
+        seqs = [fed.scrape()["seq"] for _ in range(5)]
+        assert seqs == [1, 2, 3, 4, 5]
+        hist = fed.history(limit=50)
+        assert [h["seq"] for h in hist] == [3, 4, 5]  # ring=3, oldest first
+        assert fed.history(limit=1) == hist[-1:]
+        assert fed.history(limit=0) == []
+        assert fed.history(limit=-2) == []
+
+    def test_expired_pod_skipped_without_fetching(self):
+        calls = []
+
+        def fetch(path):
+            calls.append(path)
+            return _stats("dead")
+
+        fed = FleetFederator(health=_StubHealth(expired={"dead"}))
+        fed.register_pod("dead", fetch=fetch)
+        fed.register_pod("live", fetch=_stub_fetch(_stats("live")))
+        snap = fed.scrape()
+        assert calls == []  # the skip gate: zero fetches for the dead pod
+        assert snap["pods"]["dead"] == {
+            "ok": False,
+            "skipped": "expired",
+            "health": {
+                "known": True, "expired": True, "suspect": False,
+                "draining": False, "age_s": 0.0,
+            },
+        }
+        assert snap["pods"]["live"]["ok"] is True
+        assert fed.snapshot()["pods_skipped_dead"] == 1
+        assert snap["fleet"] == {
+            "pods_ok": 1,
+            "pods_failed": 1,
+            "tiers": {"tpu_hbm": {"used": 16, "total": 64, "fill": 0.25}},
+            "health_score": 0.5,  # mean(1.0 live, 0.0 dead)
+        }
+
+    def test_stats_failure_is_an_error_row(self):
+        def fetch(path):
+            raise OSError("connection refused")
+
+        fed = FleetFederator()
+        fed.register_pod("down", fetch=fetch)
+        snap = fed.scrape()
+        row = snap["pods"]["down"]
+        assert row["ok"] is False and "OSError" in row["error"]
+        assert fed.snapshot()["scrape_errors"] == 1
+        assert snap["fleet"]["health_score"] == 0.0
+
+    def test_missing_debug_surface_is_not_an_error(self):
+        def fetch(path):
+            if path == "/stats":
+                return _stats()
+            raise OSError("404")  # pod predates the debug plane
+
+        fed = FleetFederator()
+        fed.register_pod("old", fetch=fetch)
+        snap = fed.scrape()
+        assert snap["pods"]["old"]["ok"] is True
+        assert fed.snapshot()["scrape_errors"] == 0
+
+    @pytest.mark.parametrize(
+        "extra,expected",
+        [
+            ({}, 1.0),
+            # any burn rate >= 1.0 costs 0.4
+            ({"slo": {"burn_rates": {"ttft": {"60s": 2.0}}}}, 0.6),
+            # burning below budget costs nothing
+            ({"slo": {"burn_rates": {"ttft": {"60s": 0.5}}}}, 1.0),
+            # any open breaker costs 0.2
+            (
+                {"transfer": {"breakers": {"tcp://x": {"state": "open"}}}},
+                0.8,
+            ),
+            # quarantined copies cost 0.1
+            ({"integrity": {"quarantined": 3}}, 0.9),
+            # draining caps at 0.5 (even an otherwise-healthy pod)
+            ({"drain": {"draining": True}}, 0.5),
+        ],
+    )
+    def test_health_score_formula(self, extra, expected):
+        stats = _stats()
+        for key, val in extra.items():
+            if key == "transfer":
+                stats["transfer"] = val
+            elif key == "drain":
+                stats["drain"] = val
+            else:
+                stats[key] = val
+        fed = FleetFederator()
+        fed.register_pod("p0", fetch=_stub_fetch(stats))
+        assert fed.scrape()["fleet"]["health_score"] == expected
+        assert fed.health_score() == expected
+
+    def test_health_score_hbm_pressure_and_clamp(self):
+        # fill >= 0.95 costs 0.2; penalties stack and clamp at 0.
+        stats = _stats(total=64, free=2)
+        stats["slo"] = {"burn_rates": {"ttft": {"60s": 9.0}}}
+        stats["transfer"] = {"breakers": {"a": {"state": "open"}}}
+        stats["integrity"] = {"quarantined": 1}
+        fed = FleetFederator()
+        fed.register_pod("p0", fetch=_stub_fetch(stats))
+        # 1.0 - 0.4 - 0.2 - 0.2 - 0.1 = 0.1
+        assert fed.scrape()["fleet"]["health_score"] == 0.1
+
+    def test_health_score_none_on_empty_fleet(self):
+        fed = FleetFederator()
+        assert fed.scrape()["fleet"]["health_score"] is None
+        assert fed.health_score() is None
+
+    def test_staleness_join_writes_events_behind(self):
+        class StubStaleness:
+            def snapshot(self):
+                return {"events_behind": {"p0": 7, "ghost": 3}}
+
+        fed = FleetFederator(staleness=StubStaleness())
+        fed.register_pod("p0", fetch=_stub_fetch(_stats("p0")))
+        snap = fed.scrape()
+        assert snap["pods"]["p0"]["events_behind"] == 7
+        assert snap["staleness"]["events_behind"]["ghost"] == 3
+
+    def test_on_scrape_hook_fires_and_failures_are_swallowed(self):
+        seen = []
+
+        def hook(took, errors, skipped, health):
+            seen.append((errors, skipped, health))
+            raise RuntimeError("metrics mirror broke")
+
+        fed = FleetFederator(on_scrape=hook)
+        fed.register_pod("p0", fetch=_stub_fetch(_stats()))
+        snap = fed.scrape()  # the hook raising must not break the scrape
+        assert snap["pods"]["p0"]["ok"] is True
+        assert seen == [(0, 0, 1.0)]
+
+    def test_delta_row_shape(self):
+        stats = _stats(total=64, free=0)
+        stats["slo"] = {"burn_rates": {"ttft": {"60s": 1.5, "300s": 0.4}}}
+        fed = FleetFederator()
+        fed.register_pod("p0", fetch=_stub_fetch(stats))
+        fed.scrape()
+        (row,) = fed.history()
+        assert row["pods"]["p0"] == {
+            "ok": True, "hbm_fill": 1.0, "burn_max": 1.5, "draining": False,
+        }
+        assert row["health_score"] == 0.4  # burn (0.4) + hbm pressure (0.2)
+
+    def test_debug_fleet_payload_contract(self):
+        assert debug_fleet_payload(None, {}) == (
+            200,
+            {"enabled": False, "pods": {}, "history": []},
+        )
+        fed = FleetFederator()
+        fed.register_pod("p0", fetch=_stub_fetch(_stats()))
+        status, payload = debug_fleet_payload(fed, {"limit": "zzz"})
+        assert status == 400 and "limit" in payload["error"]
+        status, payload = debug_fleet_payload(fed, {"limit": "0"})
+        assert status == 200 and payload["history"] == []
+        assert payload["enabled"] is True and payload["pods"]["p0"]["ok"]
+        # Each GET is a FRESH scrape, not a cached view.
+        assert debug_fleet_payload(fed, {})[1]["seq"] == payload["seq"] + 1
+
+    def test_scrape_surfaces_pinned(self):
+        # kvtop, the docs, and the pods' route tables all assume this set.
+        assert SCRAPE_SURFACES == (
+            "/stats",
+            "/debug/staleness",
+            "/debug/mrc",
+            "/debug/lifecycle",
+            "/debug/audit",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Scorer HTTP surface (/debug/fleet, /stats fed block, knobs-off parity)
+# ---------------------------------------------------------------------------
+
+
+def _run_scorer(scenario, **cfg_kw):
+    svc = ScoringService(
+        ServiceConfig(native_index=False, enable_metrics=False, **cfg_kw)
+    )
+
+    async def runner():
+        ts = TestServer(svc.build_app())
+        client = TestClient(ts)
+        await client.start_server()
+        try:
+            await scenario(client, svc)
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(runner())
+    finally:
+        svc.indexer.shutdown()
+
+
+class TestScorerFederationEndpoint:
+    def test_knob_off_is_disabled_shaped_and_stats_unchanged(self):
+        async def scenario(c, svc):
+            assert svc.federator is None
+            resp = await c.get("/debug/fleet")
+            assert resp.status == 200
+            assert await resp.json() == {
+                "enabled": False, "pods": {}, "history": [],
+            }
+            stats = await (await c.get("/stats")).json()
+            assert "fed" not in stats
+            # The knobs-off scorer /stats key set stays bit-identical.
+            assert set(stats) == {
+                "fleet", "subscriber", "events_rejected_after_shutdown",
+                "index_size", "index",
+            }
+
+        _run_scorer(scenario)
+
+    def test_knob_on_scrapes_and_stats_gains_fed_block(self):
+        async def scenario(c, svc):
+            assert svc.federator is not None
+            svc.federator.register_pod("p0", fetch=_stub_fetch(_stats("p0")))
+            resp = await c.get("/debug/fleet")
+            assert resp.status == 200
+            assert resp.content_type == "application/json"
+            data = await resp.json()
+            assert data["enabled"] is True
+            assert data["pods"]["p0"]["ok"] is True
+            assert data["fleet"]["health_score"] == 1.0
+            assert len(data["history"]) == 1
+            resp = await c.get("/debug/fleet?limit=bogus")
+            assert resp.status == 400
+            stats = await (await c.get("/stats")).json()
+            assert stats["fed"]["pods_registered"] == 1
+            # One scrape per successful GET (the bogus-limit GET failed
+            # validation before scraping).
+            assert stats["fed"]["scrapes"] == 1
+            assert stats["fed"]["scrape_errors"] == 0
+
+        _run_scorer(scenario, obs_fed=True)
+
+    def test_from_env_reads_fed_knobs(self, monkeypatch):
+        monkeypatch.setenv("OBS_FED", "1")
+        monkeypatch.setenv("OBS_FED_RING", "7")
+        monkeypatch.setenv("OBS_FED_TIMEOUT_S", "0.25")
+        monkeypatch.setenv("OBS_EXEMPLARS", "1")
+        cfg = ServiceConfig.from_env()
+        assert cfg.obs_fed is True and cfg.obs_exemplars is True
+        assert cfg.obs_fed_ring == 7 and cfg.obs_fed_timeout_s == 0.25
+        for var in ("OBS_FED", "OBS_FED_RING", "OBS_FED_TIMEOUT_S",
+                    "OBS_EXEMPLARS"):
+            monkeypatch.delenv(var)
+        cfg = ServiceConfig.from_env()
+        assert cfg.obs_fed is False and cfg.obs_exemplars is False
+
+
+# ---------------------------------------------------------------------------
+# The acceptance pin: 4-pod fleet, joined vs direct
+# ---------------------------------------------------------------------------
+
+
+class TestFourPodJoinedVsDirect:
+    def test_debug_fleet_agrees_with_each_pods_own_surfaces(self):
+        # Pod 0 runs with an impossible TTFT objective so one completed
+        # request forces an SLO burn >= 1 into its /stats slo block; the
+        # other three are legacy-shaped (no obs knobs).
+        pods = [
+            PodServer(
+                _pod_config(
+                    "fed-p0", obs_slo="ttft:0.000001:0.99", obs_metrics=True
+                )
+            )
+        ] + [PodServer(_pod_config(f"fed-p{i}")) for i in range(1, 4)]
+        for p in pods:
+            p.start()
+        svc = ScoringService(
+            ServiceConfig(
+                native_index=False, enable_metrics=False,
+                obs_fed=True, obs_audit=True,
+            )
+        )
+
+        async def runner():
+            loop = asyncio.get_running_loop()
+            runners, direct = [], {}
+            try:
+                for i, pod in enumerate(pods):
+                    runner_ = web.AppRunner(pod.build_app())
+                    await runner_.setup()
+                    runners.append(runner_)
+                    port = free_tcp_port()
+                    site = web.TCPSite(runner_, "127.0.0.1", port)
+                    await site.start()
+                    svc.federator.register_pod(
+                        f"fed-p{i}", url=f"http://127.0.0.1:{port}"
+                    )
+                # One real completion on pod 0 → ttft burn + prefill stats.
+                ts = TestServer(svc.build_app())
+                client = TestClient(ts)
+                await client.start_server()
+                import aiohttp
+
+                async with aiohttp.ClientSession() as sess:
+                    url = f"http://127.0.0.1:{runners[0].addresses[0][1]}"
+                    resp = await sess.post(
+                        url + "/v1/completions",
+                        json={
+                            "prompt_token_ids": _prompt(0, 12),
+                            "max_tokens": 3,
+                        },
+                    )
+                    assert resp.status == 200
+                try:
+                    # The federated view, over real HTTP to real pods.
+                    resp = await client.get("/debug/fleet")
+                    assert resp.status == 200
+                    snap = await resp.json()
+                    # Direct per-pod surfaces for the equality check
+                    # (urllib in the federator runs in an executor; here
+                    # the fetches ride the test loop's own session).
+                    async with aiohttp.ClientSession() as sess:
+                        for i, runner_ in enumerate(runners):
+                            port = runner_.addresses[0][1]
+                            base = f"http://127.0.0.1:{port}"
+                            direct[f"fed-p{i}"] = await (
+                                await sess.get(base + "/stats")
+                            ).json()
+                finally:
+                    await client.close()
+            finally:
+                for runner_ in runners:
+                    await runner_.cleanup()
+            return snap, direct
+
+        try:
+            snap, direct = asyncio.run(runner())
+        finally:
+            svc.indexer.shutdown()
+            for p in pods:
+                p.shutdown()
+
+        assert snap["fleet"]["pods_ok"] == 4
+        assert snap["fleet"]["pods_failed"] == 0
+        for name, stats in direct.items():
+            row = snap["pods"][name]
+            assert row["ok"] is True, row
+            # Tier occupancy agrees with the pod's own ledger.
+            total, free = stats["total_pages"], stats["free_pages"]
+            assert row["tiers"]["tpu_hbm"]["total"] == total
+            assert row["tiers"]["tpu_hbm"]["used"] == total - free
+            assert row["draining"] is stats["drain"]["draining"]
+            # Hit/miss attribution mix == the pod's own prefill counters.
+            assert row["attribution"] == stats["prefill"]
+        # SLO burn: the joined row carries pod 0's own burn rates, and the
+        # impossible objective burned >= 1 on at least one window.
+        burn = snap["pods"]["fed-p0"]["slo_burn"]
+        assert burn == direct["fed-p0"]["slo"]["burn_rates"]
+        assert any(
+            rate is not None and rate >= 1.0
+            for windows in burn.values()
+            for rate in windows.values()
+        )
+        for i in range(1, 4):  # legacy pods: no invented slo block
+            assert "slo_burn" not in snap["pods"][f"fed-p{i}"]
+        # Staleness: the joined top-level block is the scorer's own
+        # tracker view (pods publish no events here, so behind is empty
+        # on both sides — the agreement is the point).
+        assert snap["staleness"]["events_behind"] == {}
+        # The fleet tier rollup sums the per-pod ledgers.
+        hbm = snap["fleet"]["tiers"]["tpu_hbm"]
+        assert hbm["total"] == sum(s["total_pages"] for s in direct.values())
+        assert hbm["used"] == sum(
+            s["total_pages"] - s["free_pages"] for s in direct.values()
+        )
+
+
+# ---------------------------------------------------------------------------
+# Trace exemplars (OBS_EXEMPLARS)
+# ---------------------------------------------------------------------------
+
+
+_EXEMPLAR_RE = re.compile(
+    r'kvcache_request_ttft_seconds_bucket\{[^}]*\}\s+\S+\s+'
+    r'#\s+\{trace_id="([0-9a-f]{32})"\}'
+)
+
+
+class TestExemplars:
+    def test_tail_ttft_bucket_exemplar_resolves_in_debug_traces(self):
+        server = PodServer(
+            _pod_config(
+                "exm-pod",
+                obs_tracing=True,
+                obs_metrics=True,
+                obs_exemplars=True,
+            )
+        )
+        server.start()
+
+        async def runner():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"prompt_token_ids": _prompt(3, 12), "max_tokens": 3},
+                )
+                assert resp.status == 200
+                resp = await client.get("/metrics")
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "application/openmetrics-text"
+                )
+                text = (await resp.read()).decode()
+                match = _EXEMPLAR_RE.search(text)
+                assert match, "no exemplar on any ttft bucket"
+                tid = match.group(1)
+                resp = await client.get(f"/debug/traces?trace_id={tid}")
+                data = await resp.json()
+                assert data["enabled"] is True
+                (trace,) = data["traces"]
+                assert trace["trace_id"] == tid
+                assert any(
+                    s["name"] == "pod.request" for s in trace["spans"]
+                )
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            server.shutdown()
+
+    def test_knob_off_keeps_classic_exposition_bit_identical(self):
+        server = PodServer(_pod_config("exm-off", obs_metrics=True))
+        server.start()
+
+        async def runner():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/v1/completions",
+                    json={"prompt_token_ids": _prompt(4, 12), "max_tokens": 3},
+                )
+                assert resp.status == 200
+                resp = await client.get("/metrics")
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = (await resp.read()).decode()
+                # No exemplar syntax anywhere in the classic bytes, and
+                # the TTFT family is present to prove we looked at the
+                # exposition that WOULD carry them.
+                assert "kvcache_request_ttft_seconds_bucket" in body
+                assert "trace_id=" not in body
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            server.shutdown()
+
+    def test_serving_metrics_pull_exemplar_unit(self):
+        pytest.importorskip("prometheus_client")
+        from prometheus_client.openmetrics import exposition as om
+
+        m = _ServingMetrics(obs=True, exemplars=True)
+        m.observe_pull(0.02, "ok", trace_id="ab" * 16)
+        text = om.generate_latest(m.registry).decode()
+        assert 'trace_id="' + "ab" * 16 + '"' in text
+        # Same observation without a trace id: plain bucket, no exemplar.
+        m2 = _ServingMetrics(obs=True, exemplars=True)
+        m2.observe_pull(0.02, "ok")
+        assert "trace_id=" not in om.generate_latest(m2.registry).decode()
+
+    def test_scorer_metrics_switch_content_type_under_knob(self):
+        async def scenario(c, svc):
+            resp = await c.get("/metrics")
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text"
+            )
+
+        _run_scorer(scenario, obs_exemplars=True)
+
+        async def scenario_off(c, svc):
+            resp = await c.get("/metrics")
+            assert resp.headers["Content-Type"].startswith("text/plain")
+
+        _run_scorer(scenario_off)
+
+    def test_collector_score_latency_exemplar(self):
+        prom = pytest.importorskip("prometheus_client")
+        from prometheus_client.openmetrics import exposition as om
+
+        collector.register()
+        collector.observe_score_latency(0.004, trace_id="cd" * 16)
+        text = om.generate_latest(prom.REGISTRY).decode()
+        assert 'trace_id="' + "cd" * 16 + '"' in text
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: /stats is ONE locked cut
+# ---------------------------------------------------------------------------
+
+
+class _CountingLock:
+    """Lock proxy counting per-thread acquisitions — the /stats one-cut
+    pin. Per-thread so the pod's background loops (which also take _mu on
+    their own threads) cannot pollute the handler-thread count."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.by_thread: dict = {}
+
+    def _count(self):
+        tid = threading.get_ident()
+        self.by_thread[tid] = self.by_thread.get(tid, 0) + 1
+
+    def __enter__(self):
+        self._count()
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self._inner.__exit__(*exc)
+
+    def acquire(self, *a, **kw):
+        self._count()
+        return self._inner.acquire(*a, **kw)
+
+    def release(self):
+        return self._inner.release()
+
+
+class TestStatsSingleCut:
+    def test_stats_acquires_the_server_lock_exactly_once(self):
+        # fleet_controller on: the fleet block used to re-acquire _mu for
+        # the migration counters — a second hold in one scrape could pair
+        # fresh migration counts with stale queue state.
+        server = PodServer(_pod_config("cut-pod", fleet_controller=True))
+        server.start()
+        proxy = _CountingLock(server._mu)
+        server._mu = proxy
+
+        async def runner():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                # The stats handler runs on this (the event loop's) thread.
+                tid = threading.get_ident()
+                before = proxy.by_thread.get(tid, 0)
+                resp = await client.get("/stats")
+                stats = await resp.json()
+                handler_holds = proxy.by_thread.get(tid, 0) - before
+                assert stats["fleet"]["migrations_out"] == 0
+                # Exactly one locked cut per scrape.
+                assert handler_holds == 1, handler_holds
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            server._mu = proxy._inner
+            server.shutdown()
+
+    def test_migration_counters_never_torn(self):
+        # Writer bumps migrations_out and migrations_in TOGETHER under
+        # _mu; any scrape observing them unequal read a torn cut.
+        server = PodServer(_pod_config("torn-pod", fleet_controller=True))
+        server.start()
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                with server._mu:
+                    server.migrations_out += 1
+                    server.migrations_in += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+
+        async def runner():
+            ts = TestServer(server.build_app())
+            client = TestClient(ts)
+            await client.start_server()
+            try:
+                for _ in range(50):
+                    stats = await (await client.get("/stats")).json()
+                    fleet = stats["fleet"]
+                    assert fleet["migrations_out"] == fleet["migrations_in"]
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(runner())
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: debug-endpoint conformance on both APIs
+# ---------------------------------------------------------------------------
+
+#: route -> the payload field holding the capped rows (absent field is an
+#: acceptable "nothing" — e.g. a flight recorder with no timeline yet).
+_POD_DEBUG_ROUTES = {
+    "/debug/traces": "traces",
+    "/debug/lifecycle": "recent",
+    "/debug/mrc": "curve",
+    "/debug/flight": "timeline",
+}
+_SCORER_DEBUG_ROUTES = {
+    "/debug/traces": "traces",
+    "/debug/staleness": "per_pod_event",
+    "/debug/audit": "audits",
+    "/debug/lifecycle": "recent",
+    "/debug/mrc": "curve",
+    "/debug/fleet": "history",
+}
+
+
+async def _start_client(app):
+    """TestClient must be built on a running loop (its CookieJar grabs
+    the running loop at construction)."""
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _assert_capped_empty(payload, field):
+    if field == "timeline":
+        timeline = payload.get("timeline")
+        assert timeline is None or timeline.get("entries") in ([], None)
+        return
+    rows = payload.get(field)
+    assert rows in ([], {}, None), (field, rows)
+
+
+class TestDebugEndpointConformance:
+    @pytest.fixture(scope="class")
+    def pod_client(self):
+        # All debug knobs on so every endpoint parses its limit (a
+        # disabled endpoint short-circuits before the query).
+        server = PodServer(
+            _pod_config(
+                "dbg-pod",
+                obs_tracing=True,
+                obs_lifecycle=True,
+                obs_flight=True,
+            )
+        )
+        server.start()
+        loop = asyncio.new_event_loop()
+        client = loop.run_until_complete(_start_client(server.build_app()))
+        yield loop, client
+        loop.run_until_complete(client.close())
+        loop.close()
+        server.shutdown()
+
+    @pytest.fixture(scope="class")
+    def scorer_client(self):
+        svc = ScoringService(
+            ServiceConfig(
+                native_index=False, enable_metrics=False,
+                obs_tracing=True, obs_audit=True, obs_lifecycle=True,
+                obs_fed=True,
+            )
+        )
+        loop = asyncio.new_event_loop()
+        client = loop.run_until_complete(_start_client(svc.build_app()))
+        yield loop, client
+        loop.run_until_complete(client.close())
+        loop.close()
+        svc.indexer.shutdown()
+
+    @pytest.mark.parametrize("route", sorted(_POD_DEBUG_ROUTES))
+    def test_pod_debug_conformance(self, pod_client, route):
+        loop, client = pod_client
+        self._conformance(loop, client, route, _POD_DEBUG_ROUTES[route])
+
+    @pytest.mark.parametrize("route", sorted(_SCORER_DEBUG_ROUTES))
+    def test_scorer_debug_conformance(self, scorer_client, route):
+        loop, client = scorer_client
+        self._conformance(loop, client, route, _SCORER_DEBUG_ROUTES[route])
+
+    @staticmethod
+    def _conformance(loop, client, route, field):
+        async def scenario():
+            # Default query: 200, JSON.
+            resp = await client.get(route)
+            assert resp.status == 200
+            assert resp.content_type == "application/json"
+            # limit<=0 returns nothing (the Tracer contract).
+            for limit in ("0", "-3"):
+                resp = await client.get(f"{route}?limit={limit}")
+                assert resp.status == 200, route
+                _assert_capped_empty(await resp.json(), field)
+            # Junk limit: tolerant 400, JSON error body, never a 500.
+            resp = await client.get(f"{route}?limit=bogus")
+            assert resp.status == 400, route
+            assert resp.content_type == "application/json"
+            assert "limit" in (await resp.json())["error"]
+
+        loop.run_until_complete(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: two-way exposition sweep vs the docs catalog
+# ---------------------------------------------------------------------------
+
+
+def _docs_catalog_names():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs",
+        "observability.md",
+    )
+    names = set()
+    with open(path) as fh:
+        for line in fh:
+            m = re.match(r"\|\s*`(kvcache_[a-z0-9_]+)`", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def _exposition_types(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ")
+            out[name] = typ
+    return out
+
+
+class TestExpositionSweep:
+    def test_every_catalog_family_is_emitted_and_vice_versa(self):
+        prom = pytest.importorskip("prometheus_client")
+        # The pod surface with every registry-shaping knob on, plus the
+        # scorer's global collector registry: between them, every
+        # documented family must appear as a # TYPE line (registered
+        # families expose TYPE even with zero samples).
+        m = _ServingMetrics(
+            obs=True, lifecycle=True, tenant_qos=True, integrity=True
+        )
+        collector.register()
+        emitted = {
+            name
+            for name in {
+                **_exposition_types(m.exposition().decode()),
+                **_exposition_types(prom.generate_latest().decode()),
+            }
+            # _created series are prometheus_client bookkeeping, not
+            # catalog families.
+            if name.startswith("kvcache_") and not name.endswith("_created")
+        }
+        docs = _docs_catalog_names()
+        assert docs, "catalog extraction found nothing — regex drift?"
+        missing = docs - emitted
+        assert not missing, f"documented but never emitted: {sorted(missing)}"
+        undocumented = emitted - docs
+        assert not undocumented, (
+            f"emitted but not in docs/observability.md: {sorted(undocumented)}"
+        )
+
+    def test_federation_families_present_under_knob(self):
+        prom = pytest.importorskip("prometheus_client")
+        collector.register()
+        collector.observe_fleet_scrape(0.01, errors=1, skipped=2, health=0.75)
+        types = _exposition_types(prom.generate_latest().decode())
+        assert types["kvcache_fleet_health_score"] == "gauge"
+        assert types["kvcache_fleet_scrape_seconds"] == "histogram"
+        assert types["kvcache_fleet_scrape_errors_total"] == "counter"
+        assert types["kvcache_fleet_scrape_pods_skipped_total"] == "counter"
+
+
+# ---------------------------------------------------------------------------
+# kvtop
+# ---------------------------------------------------------------------------
+
+
+class TestKvtop:
+    def _fed(self):
+        fed = FleetFederator()
+        burn_stats = _stats("pod-burn", total=64, free=2)
+        burn_stats["slo"] = {"burn_rates": {"ttft": {"60s": 2.5}}}
+        burn_stats["drain"] = {"draining": True}
+        fed.register_pod("pod-burn", fetch=_stub_fetch(burn_stats))
+        fed.register_pod("pod-ok", fetch=_stub_fetch(_stats("pod-ok")))
+
+        def down(path):
+            raise OSError("refused")
+
+        fed.register_pod("pod-down", fetch=down)
+        return fed
+
+    def test_render_against_in_process_federator(self):
+        from tools.kvtop import fetch_snapshot, render_plain
+
+        fed = self._fed()
+        fed.scrape()  # a prior scrape so history has a sparkline point
+        frame = render_plain(fetch_snapshot(fed))
+        assert "kvtop — fleet seq 2" in frame
+        assert "pods 2 ok / 1 failed" in frame
+        assert "DOWN (OSError: refused)" in frame
+        assert "DRAINING" in frame and "BURN 2.5x" in frame
+        assert "tpu_hbm" in frame and "health" in frame
+
+    def test_render_disabled_payload(self):
+        from tools.kvtop import render_plain
+
+        frame = render_plain({"enabled": False})
+        assert "federation disabled" in frame and "OBS_FED=1" in frame
+
+    def test_fetch_against_scorer_url(self):
+        from tools.kvtop import fetch_snapshot, render_plain
+
+        svc = ScoringService(
+            ServiceConfig(
+                native_index=False, enable_metrics=False, obs_fed=True
+            )
+        )
+        svc.federator.register_pod("p0", fetch=_stub_fetch(_stats("p0")))
+
+        async def runner():
+            loop = asyncio.get_running_loop()
+            runner_ = web.AppRunner(svc.build_app())
+            await runner_.setup()
+            try:
+                port = free_tcp_port()
+                site = web.TCPSite(runner_, "127.0.0.1", port)
+                await site.start()
+                # urllib blocks — keep the serving loop free.
+                return await loop.run_in_executor(
+                    None,
+                    fetch_snapshot,
+                    f"http://127.0.0.1:{port}",
+                )
+            finally:
+                await runner_.cleanup()
+
+        try:
+            payload = asyncio.run(runner())
+        finally:
+            svc.indexer.shutdown()
+        assert payload["enabled"] is True
+        frame = render_plain(payload)
+        assert "p0" in frame and "pods 1 ok / 0 failed" in frame
+
+    def test_cli_once_against_down_scorer_reports_error(self, capsys):
+        from tools.kvtop.__main__ import main
+
+        port = free_tcp_port()  # nothing listening
+        rc = main([
+            "--url", f"http://127.0.0.1:{port}", "--once", "--timeout", "0.2",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "kvtop: fetch failed" in out
+
+    def test_sparkline_and_bar_primitives(self):
+        from tools.kvtop import _bar, sparkline
+
+        assert _bar(0.0) == "[----------]   0%"
+        assert _bar(1.0) == "[##########] 100%"
+        assert _bar(None).endswith("--")
+        assert sparkline([0.0, 0.5, 1.0, None]) == "▁▅█ "

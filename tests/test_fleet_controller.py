@@ -193,7 +193,7 @@ class TestFleetMRC:
         est = ReuseDistanceEstimator(sample_rate=sample_rate)
         for chain in stream:
             est.observe_chain(chain)
-        return debug_mrc_payload(est), est
+        return debug_mrc_payload(est)[1], est
 
     def test_aggregate_equals_per_pod_sum_on_synthetic_stream(self):
         """THE satellite-2 identity: at every grid capacity the aggregate

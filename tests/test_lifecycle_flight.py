@@ -394,10 +394,10 @@ class TestMRC:
         est = ReuseDistanceEstimator(on_distance=dists.append)
         est.observe_chain([1, 2, 1])
         assert dists == [float("inf"), float("inf"), 1.0]
-        payload = debug_mrc_payload(est, tier_capacities={"tpu_hbm": 4})
+        payload = debug_mrc_payload(est, tier_capacities={"tpu_hbm": 4})[1]
         assert payload["enabled"] is True
         assert payload["tiers"]["tpu_hbm"]["predicted_hit_rate"] is not None
-        assert debug_mrc_payload(None) == {"enabled": False}
+        assert debug_mrc_payload(None) == (200, {"enabled": False})
 
     def test_bad_sample_rate_rejected(self):
         with pytest.raises(ValueError):
@@ -451,7 +451,7 @@ class TestFlightRecorder:
             on_disk = json.load(f)
         assert on_disk["reason"] == "slo_burn"
         assert [e["kind"] for e in on_disk["entries"]] == kinds
-        payload = debug_flight_payload(fr)
+        payload = debug_flight_payload(fr)[1]
         assert payload["enabled"] and payload["timeline"]["reason"] == "slo_burn"
 
     def test_dump_rate_limited_per_reason(self, tmp_path):

@@ -279,6 +279,13 @@ _SCORER_OBS_METRICS = {
     "kvcache_route_predicted_vs_realized_blocks": "histogram",
     "kvcache_route_regret_blocks": "histogram",
     "kvcache_route_miss_attributed_total": "counter",
+    # Fleet observability federation (ISSUE 20; series appear when
+    # OBS_FED scrapes feed them, the families register unconditionally
+    # like every collector family above)
+    "kvcache_fleet_health_score": "gauge",
+    "kvcache_fleet_scrape_seconds": "histogram",
+    "kvcache_fleet_scrape_errors_total": "counter",
+    "kvcache_fleet_scrape_pods_skipped_total": "counter",
 }
 
 

@@ -404,9 +404,9 @@ class TestRouteAuditor:
         assert status == 200 and payload == {"enabled": False, "audits": []}
 
     def test_debug_staleness_payload_disabled_without_tracker(self):
-        assert debug_staleness_payload(None) == {"enabled": False}
+        assert debug_staleness_payload(None, {}) == (200, {"enabled": False})
         t = StalenessTracker(clock=lambda: 1.0)
-        assert debug_staleness_payload(t)["enabled"] is True
+        assert debug_staleness_payload(t, {})[1]["enabled"] is True
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,84 @@
+"""CLI: ``python -m tools.kvtop --url http://scorer:8080``.
+
+``--plain`` prints frames to stdout (pipes/CI); the default paints a
+curses screen. ``--once`` renders a single frame and exits — the smoke
+mode the tests and the runbook's first triage step use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from tools.kvtop import fetch_snapshot, render_plain
+
+
+def _one_frame(url: str, timeout_s: float) -> str:
+    try:
+        return render_plain(fetch_snapshot(url, timeout_s=timeout_s))
+    except Exception as exc:  # noqa: BLE001 — console keeps running
+        return f"kvtop: fetch failed: {type(exc).__name__}: {exc}"
+
+
+def _curses_loop(url: str, interval: float, timeout_s: float) -> int:
+    import curses
+
+    def loop(screen):
+        curses.curs_set(0)
+        screen.timeout(int(interval * 1000))
+        while True:
+            frame = _one_frame(url, timeout_s)
+            screen.erase()
+            rows, cols = screen.getmaxyx()
+            for i, line in enumerate(frame.splitlines()[: rows - 1]):
+                try:
+                    screen.addnstr(i, 0, line, cols - 1)
+                except curses.error:
+                    pass
+            screen.refresh()
+            if screen.getch() in (ord("q"), 27):
+                return
+
+    curses.wrapper(loop)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.kvtop",
+        description="live console for the federated fleet view (OBS_FED)",
+    )
+    parser.add_argument(
+        "--url",
+        required=True,
+        help="scorer base URL (serves GET /debug/fleet under OBS_FED=1)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0, help="refresh seconds"
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=5.0, help="fetch timeout seconds"
+    )
+    parser.add_argument(
+        "--plain", action="store_true", help="print frames (no curses)"
+    )
+    parser.add_argument(
+        "--once", action="store_true", help="render one frame and exit"
+    )
+    args = parser.parse_args(argv)
+    if args.once:
+        print(_one_frame(args.url, args.timeout))
+        return 0
+    if args.plain:
+        try:
+            while True:
+                print(_one_frame(args.url, args.timeout), flush=True)
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+    return _curses_loop(args.url, args.interval, args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
